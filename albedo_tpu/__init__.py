@@ -1,0 +1,33 @@
+"""albedo-tpu: a TPU-native two-stage recommender framework.
+
+A ground-up JAX/XLA rebuild of the capabilities of the reference albedo system
+(implicit-ALS candidate generation + logistic-regression ranking over a GitHub
+user x repo star matrix, with popularity / curation / content recommenders,
+Word2Vec text features, profile ETL, and an NDCG@k ranking evaluator).
+
+Layer map (mirrors SURVEY.md section 1, re-architected TPU-first):
+
+- ``albedo_tpu.datasets``  -- host-side IO: star-matrix ingest, bijective id
+  reindexing, stratified splits, date-keyed artifact cache. Replaces the
+  reference's JDBC + parquet layer (``utils/DatasetUtils.scala``).
+- ``albedo_tpu.ops``       -- device compute primitives: bucketed ragged
+  gathers, Gramian accumulation, batched Cholesky solves, blocked score GEMM +
+  top-k (XLA and Pallas paths). Replaces netlib BLAS hot loops.
+- ``albedo_tpu.models``    -- ImplicitALS, LogisticRegression, Word2Vec as
+  JAX estimators. Replaces Spark MLlib ``ALS``/``LogisticRegression``/``Word2Vec``.
+- ``albedo_tpu.pipeline``  -- Estimator/Transformer/Pipeline protocol and the
+  feature transformer library. Replaces ``transformers/`` + ``org.apache.spark.ml.feature``.
+- ``albedo_tpu.recommenders`` -- candidate generators behind one ``Recommender``
+  protocol. Replaces ``recommenders/``.
+- ``albedo_tpu.evaluators``   -- ranking (NDCG/P@k/MAP) + binary (AUC) metrics.
+  Replaces ``evaluators/RankingEvaluator.scala``.
+- ``albedo_tpu.parallel``  -- device-mesh construction, sharding specs, and
+  collective helpers (psum/all_gather over ICI). Replaces the Spark
+  shuffle/broadcast runtime.
+- ``albedo_tpu.builders``  -- entry-point jobs mirroring the reference L4
+  ``*Builder`` mains.
+"""
+
+__version__ = "0.1.0"
+
+from albedo_tpu import settings  # noqa: F401

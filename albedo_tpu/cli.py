@@ -1,0 +1,46 @@
+"""CLI entry point: ``albedo-tpu <job> [options]``.
+
+Replaces the reference's Makefile targets (``make train_als``, ``make train_lr``,
+... each wrapping ``spark-submit --class ws.vinta.albedo.X``, ``Makefile:131-218``).
+Jobs are registered by the builder modules as they land.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import Callable
+
+_JOBS: dict[str, Callable[[argparse.Namespace], None]] = {}
+
+
+def register_job(name: str):
+    def deco(fn: Callable[[argparse.Namespace], None]):
+        _JOBS[name] = fn
+        return fn
+
+    return deco
+
+
+def main(argv: list[str] | None = None) -> int:
+    _load_builders()
+    parser = argparse.ArgumentParser(prog="albedo-tpu")
+    parser.add_argument("job", choices=sorted(_JOBS) or ["none"], help="job to run")
+    parser.add_argument("--small", action="store_true", help="laptop-scale run")
+    args, _rest = parser.parse_known_args(argv)
+    if args.job not in _JOBS:
+        print(f"no such job: {args.job}", file=sys.stderr)
+        return 2
+    _JOBS[args.job](args)
+    return 0
+
+
+def _load_builders() -> None:
+    try:
+        import albedo_tpu.builders  # noqa: F401  (registers jobs on import)
+    except ImportError:
+        pass
+
+
+if __name__ == "__main__":
+    sys.exit(main())

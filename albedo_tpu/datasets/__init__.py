@@ -1,0 +1,23 @@
+"""Host-side dataset layer.
+
+Replaces the reference's L1 (``utils/DatasetUtils.scala``, ``schemas/package.scala``,
+JDBC + parquet caching). Everything here is numpy/pandas on the host; device
+feeding happens in ``albedo_tpu.ops``.
+"""
+
+from albedo_tpu.datasets.artifacts import load_or_create, load_or_create_df, load_or_create_npz
+from albedo_tpu.datasets.ragged import Bucket, bucket_rows
+from albedo_tpu.datasets.split import random_split_by_user
+from albedo_tpu.datasets.star_matrix import StarMatrix
+from albedo_tpu.datasets.synthetic import synthetic_stars
+
+__all__ = [
+    "Bucket",
+    "StarMatrix",
+    "bucket_rows",
+    "load_or_create",
+    "load_or_create_df",
+    "load_or_create_npz",
+    "random_split_by_user",
+    "synthetic_stars",
+]

@@ -1,0 +1,105 @@
+"""Date-keyed artifact cache: the load-or-create memoization idiom.
+
+Reference parity: ``DatasetUtils.loadOrCreateDataFrame`` (``utils/DatasetUtils.scala:36-50``)
+and ``ModelUtils.loadOrCreateModel`` (``utils/ModelUtils.scala:7-21``) — every
+expensive product (raw tables, profiles, models, balanced datasets) is memoized
+under ``{dataDir}/{yyyyMMdd}/<name>`` and recreated only on miss, giving
+artifact-level resumability: a killed job rerun the same day resumes from the
+last materialized artifact (SURVEY.md section 5).
+
+Hyperparameters belong in the artifact name, as the reference bakes them into
+paths like ``rankerModelPipeline-$maxStarredReposCount-...parquet``.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Any, Callable, TypeVar
+
+import numpy as np
+
+from albedo_tpu.settings import get_settings
+
+T = TypeVar("T")
+
+
+def artifact_path(name: str) -> Path:
+    s = get_settings().ensure_dirs()
+    return s.artifact_dir / name
+
+
+def load_or_create(
+    name: str,
+    create: Callable[[], T],
+    save: Callable[[Path, T], None],
+    load: Callable[[Path], T],
+) -> T:
+    """Generic memoization: load ``name`` if materialized, else create+save.
+
+    Writes go through a temp path + rename so a killed job never leaves a
+    half-written artifact that a resume would trust.
+    """
+    path = artifact_path(name)
+    if path.exists():
+        return load(path)
+    value = create()
+    tmp = path.with_name(path.name + ".tmp")
+    if tmp.exists():
+        if tmp.is_dir():
+            import shutil
+
+            shutil.rmtree(tmp)
+        else:
+            tmp.unlink()
+    save(tmp, value)
+    tmp.rename(path)
+    return value
+
+
+def load_or_create_df(name: str, create: Callable[[], "Any"]):
+    """Memoize a pandas DataFrame as parquet (falls back to pickle if the
+    parquet engine is unavailable in this environment)."""
+    import pandas as pd
+
+    def _save(path: Path, df: "pd.DataFrame") -> None:
+        try:
+            df.to_parquet(path)
+        except (ImportError, ValueError):
+            df.to_pickle(path)
+
+    def _load(path: Path) -> "pd.DataFrame":
+        try:
+            return pd.read_parquet(path)
+        except (ImportError, ValueError):
+            return pd.read_pickle(path)
+
+    return load_or_create(name, create, _save, _load)
+
+
+def load_or_create_npz(name: str, create: Callable[[], dict[str, np.ndarray]]):
+    """Memoize a dict of numpy arrays (factor matrices, index maps, ...)."""
+
+    def _save(path: Path, arrays: dict[str, np.ndarray]) -> None:
+        with open(path, "wb") as f:
+            np.savez(f, **arrays)
+
+    def _load(path: Path) -> dict[str, np.ndarray]:
+        with np.load(path, allow_pickle=False) as z:
+            return {k: z[k] for k in z.files}
+
+    if not name.endswith(".npz"):
+        name = name + ".npz"
+    return load_or_create(name, create, _save, _load)
+
+
+def load_or_create_json(name: str, create: Callable[[], Any]):
+    def _save(path: Path, value: Any) -> None:
+        path.write_text(json.dumps(value, indent=2, sort_keys=True))
+
+    def _load(path: Path) -> Any:
+        return json.loads(path.read_text())
+
+    if not name.endswith(".json"):
+        name = name + ".json"
+    return load_or_create(name, create, _save, _load)

@@ -1,0 +1,110 @@
+"""Ragged -> dense bucketing for XLA-friendly sparse row access.
+
+The ALS sweep needs, per user (or per item on the alternate sweep), the dense
+gather indices and ratings of that row's nonzeros. Row lengths follow a power
+law, so one global pad-to-max would waste most of the FLOPs. Instead rows are
+sorted by length and chunked into fixed-size batches, each padded to its own
+power-of-two-ish length: XLA compiles one kernel per distinct (batch, length)
+shape, of which there are O(log max_len) (SURVEY.md section 7 hard part (a)).
+
+This is the TPU-native replacement for Spark MLlib ALS's shuffled
+user/item blocks, and for ``ALSRecommender.blockify`` (4096-row blocks,
+``recommenders/ALSRecommender.scala:21-24``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class Bucket:
+    """A fixed-shape batch of padded rows.
+
+    ``row_ids[b]`` is the dense row index this slot solves for; padding slots
+    have ``row_ids == -1``. ``idx/val`` are ``(B, L)`` with ``val == 0`` on pads
+    (so confidence weights vanish); ``idx`` points at row 0 on pads, which is
+    harmless under a zero weight.
+    """
+
+    row_ids: np.ndarray  # (B,) int32, -1 for padding slots
+    idx: np.ndarray      # (B, L) int32 column indices
+    val: np.ndarray      # (B, L) float32 ratings, 0 on padding
+    mask: np.ndarray     # (B, L) bool
+
+    @property
+    def shape(self) -> tuple[int, int]:
+        return self.idx.shape  # type: ignore[return-value]
+
+
+def _pad_len(n: int, multiple: int) -> int:
+    """Round up to a power of two, then to ``multiple`` (min ``multiple``)."""
+    if n <= multiple:
+        return multiple
+    p = 1 << (int(n - 1).bit_length())
+    return max(multiple, ((p + multiple - 1) // multiple) * multiple)
+
+
+def bucket_rows(
+    indptr: np.ndarray,
+    indices: np.ndarray,
+    vals: np.ndarray,
+    batch_size: int = 1024,
+    len_multiple: int = 8,
+    max_len: int | None = None,
+) -> list[Bucket]:
+    """Chunk CSR rows into fixed-shape padded batches.
+
+    Rows are sorted by nonzero count so batch-mates have similar lengths; each
+    batch is padded to a power-of-two length (bounded padding waste, bounded
+    compile count). Rows longer than ``max_len`` are truncated to their most
+    recent ``max_len`` entries, mirroring the reference's
+    ``maxStarredReposCount`` cap (``LogisticRegressionRanker.scala:133``).
+
+    Empty rows are skipped: ALS leaves those factors at their current value,
+    matching cold-start behavior.
+    """
+    n_rows = indptr.shape[0] - 1
+    lengths = np.diff(indptr)
+    nonempty = np.nonzero(lengths > 0)[0]
+    # Stable sort by length keeps determinism across runs.
+    order = nonempty[np.argsort(lengths[nonempty], kind="stable")]
+
+    buckets: list[Bucket] = []
+    for start in range(0, order.shape[0], batch_size):
+        chunk = order[start : start + batch_size]
+        chunk_lens = lengths[chunk]
+        cap = int(chunk_lens.max())
+        if max_len is not None:
+            cap = min(cap, max_len)
+        pad_l = _pad_len(cap, len_multiple)
+        if max_len is not None:
+            # Don't let power-of-two rounding blow past the explicit work bound.
+            pad_l = min(pad_l, -(-max_len // len_multiple) * len_multiple)
+            pad_l = max(pad_l, cap)
+
+        b = batch_size  # fixed B so at most len-bucket count of shapes exist
+        idx = np.zeros((b, pad_l), dtype=np.int32)
+        val = np.zeros((b, pad_l), dtype=np.float32)
+        mask = np.zeros((b, pad_l), dtype=bool)
+        row_ids = np.full((b,), -1, dtype=np.int32)
+
+        for slot, r in enumerate(chunk):
+            lo, hi = int(indptr[r]), int(indptr[r + 1])
+            take = hi - lo
+            if take > cap:  # keep the tail = most recent entries in insert order
+                lo = hi - cap
+                take = cap
+            row_ids[slot] = r
+            idx[slot, :take] = indices[lo:hi]
+            val[slot, :take] = vals[lo:hi]
+            mask[slot, :take] = True
+        buckets.append(Bucket(row_ids=row_ids, idx=idx, val=val, mask=mask))
+    return buckets
+
+
+def bucket_shapes(buckets: list[Bucket]) -> list[tuple[int, int]]:
+    """Distinct shapes (== number of XLA compilations the sweep will trigger)."""
+    return sorted({b.shape for b in buckets})

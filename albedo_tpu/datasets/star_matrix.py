@@ -1,0 +1,132 @@
+"""Sparse user x item star matrix with bijective id reindexing.
+
+The reference feeds raw GitHub ids straight into Spark MLlib ALS (which tolerates
+arbitrary ints); XLA wants dense 0..n-1 indices and static shapes, so this class
+owns the bijective raw-id <-> dense-index maps (SURVEY.md section 7 hard part (d))
+and the CSR/CSC views that the ALS sweeps consume.
+
+Reference parity: the ``Starring`` schema (``schemas/package.scala``) and
+``DatasetUtils.loadRawStarringDS`` (``utils/DatasetUtils.scala:111-121``) which
+adds the implicit ``starring = 1.0`` rating column.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class StarMatrix:
+    """COO interactions over dense indices, plus the raw-id vocabularies.
+
+    ``user_ids[d] == raw_user_id`` for dense index ``d`` (and likewise
+    ``item_ids``); ``rows/cols/vals`` are the nonzeros. ``vals`` is the implicit
+    rating (1.0 for a star, or a confidence weight).
+    """
+
+    user_ids: np.ndarray  # (n_users,) raw ids, int64
+    item_ids: np.ndarray  # (n_items,) raw ids, int64
+    rows: np.ndarray      # (nnz,) dense user indices, int32
+    cols: np.ndarray      # (nnz,) dense item indices, int32
+    vals: np.ndarray      # (nnz,) float32
+
+    @property
+    def n_users(self) -> int:
+        return int(self.user_ids.shape[0])
+
+    @property
+    def n_items(self) -> int:
+        return int(self.item_ids.shape[0])
+
+    @property
+    def nnz(self) -> int:
+        return int(self.rows.shape[0])
+
+    @staticmethod
+    def from_interactions(
+        raw_users: np.ndarray,
+        raw_items: np.ndarray,
+        vals: np.ndarray | None = None,
+    ) -> "StarMatrix":
+        """Build from raw-id interaction lists, deduplicating and reindexing.
+
+        Duplicate (user, item) pairs keep the last value, mirroring the unique
+        (user_id, repo_id) constraint on the reference's ratings table
+        (``app/models.py:167``).
+        """
+        raw_users = np.asarray(raw_users, dtype=np.int64)
+        raw_items = np.asarray(raw_items, dtype=np.int64)
+        if vals is None:
+            vals = np.ones(raw_users.shape[0], dtype=np.float32)
+        vals = np.asarray(vals, dtype=np.float32)
+
+        user_ids, rows = np.unique(raw_users, return_inverse=True)
+        item_ids, cols = np.unique(raw_items, return_inverse=True)
+        rows = rows.astype(np.int32)
+        cols = cols.astype(np.int32)
+
+        # Dedup (row, col), keeping the last occurrence.
+        key = rows.astype(np.int64) * item_ids.shape[0] + cols
+        order = np.arange(key.shape[0])
+        # np.unique keeps the first occurrence; scanning the reversed array makes
+        # that the last-written value -> keep-last semantics.
+        _, first_idx = np.unique(key[::-1], return_index=True)
+        keep = order[::-1][first_idx]
+        keep.sort()
+        return StarMatrix(user_ids, item_ids, rows[keep], cols[keep], vals[keep])
+
+    def users_of(self, raw_user_ids: np.ndarray) -> np.ndarray:
+        """Map raw user ids to dense indices (-1 for unknown)."""
+        return _lookup(self.user_ids, raw_user_ids)
+
+    def items_of(self, raw_item_ids: np.ndarray) -> np.ndarray:
+        return _lookup(self.item_ids, raw_item_ids)
+
+    def csr(self) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Row-sorted view: (indptr (n_users+1,), cols, vals)."""
+        order = np.argsort(self.rows, kind="stable")
+        counts = np.bincount(self.rows, minlength=self.n_users)
+        indptr = np.zeros(self.n_users + 1, dtype=np.int64)
+        np.cumsum(counts, out=indptr[1:])
+        return indptr, self.cols[order], self.vals[order]
+
+    def csc(self) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Column-sorted view: (indptr (n_items+1,), rows, vals)."""
+        order = np.argsort(self.cols, kind="stable")
+        counts = np.bincount(self.cols, minlength=self.n_items)
+        indptr = np.zeros(self.n_items + 1, dtype=np.int64)
+        np.cumsum(counts, out=indptr[1:])
+        return indptr, self.rows[order], self.vals[order]
+
+    def transpose(self) -> "StarMatrix":
+        return StarMatrix(self.item_ids, self.user_ids, self.cols, self.rows, self.vals)
+
+    def select(self, mask: np.ndarray) -> "StarMatrix":
+        """Subset of nonzeros (same vocabularies), e.g. a train/test split."""
+        return StarMatrix(
+            self.user_ids, self.item_ids, self.rows[mask], self.cols[mask], self.vals[mask]
+        )
+
+    def user_counts(self) -> np.ndarray:
+        return np.bincount(self.rows, minlength=self.n_users)
+
+    def item_counts(self) -> np.ndarray:
+        return np.bincount(self.cols, minlength=self.n_items)
+
+    def dense(self) -> np.ndarray:
+        """Materialize as a dense array. Tests/small data only."""
+        out = np.zeros((self.n_users, self.n_items), dtype=np.float32)
+        out[self.rows, self.cols] = self.vals
+        return out
+
+
+def _lookup(vocab: np.ndarray, raw: np.ndarray) -> np.ndarray:
+    raw = np.asarray(raw, dtype=np.int64)
+    if vocab.shape[0] == 0:
+        return np.full(raw.shape, -1, dtype=np.int32)
+    pos = np.searchsorted(vocab, raw)
+    pos = np.clip(pos, 0, vocab.shape[0] - 1)
+    found = vocab[pos] == raw
+    return np.where(found, pos, -1).astype(np.int32)

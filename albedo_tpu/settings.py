@@ -1,0 +1,81 @@
+"""Run-scoped configuration.
+
+Reference parity: ``settings/package.scala:12-23`` exposes ``dataDir`` /
+``checkpointDir`` (Spark conf keys with ``./spark-data`` defaults), ``today``
+(yyyyMMdd artifact partition), and ``md5``. The reference layers config three
+ways (Spark conf, ``RUN_WITH_INTELLIJ`` env switch, Makefile platform flag);
+here it is one ``Settings`` dataclass resolved from environment variables with
+programmatic overrides, plus a ``small_run`` switch equivalent to the
+reference's IntelliJ laptop mode (``LogisticRegressionRanker.scala:24-34``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import datetime as _dt
+import hashlib
+import os
+from pathlib import Path
+
+_ENV_PREFIX = "ALBEDO_"
+
+
+def _env(name: str, default: str) -> str:
+    return os.environ.get(_ENV_PREFIX + name, default)
+
+
+@dataclasses.dataclass
+class Settings:
+    """Global run configuration, resolvable from ``ALBEDO_*`` env vars."""
+
+    data_dir: Path = dataclasses.field(
+        default_factory=lambda: Path(_env("DATA_DIR", "./albedo-data"))
+    )
+    checkpoint_dir: Path = dataclasses.field(
+        default_factory=lambda: Path(_env("CHECKPOINT_DIR", "./albedo-data/checkpoints"))
+    )
+    # Laptop/dev mode: shrink datasets and iteration counts, like the
+    # reference's RUN_WITH_INTELLIJ switch.
+    small_run: bool = dataclasses.field(
+        default_factory=lambda: _env("SMALL_RUN", "0") in ("1", "true", "True")
+    )
+    # Artifact date partition; overridable so a rerun can resume yesterday's
+    # artifacts (reference: settings.today, settings/package.scala:15-19).
+    today: str = dataclasses.field(
+        default_factory=lambda: _env("TODAY", _dt.date.today().strftime("%Y%m%d"))
+    )
+
+    @property
+    def artifact_dir(self) -> Path:
+        return self.data_dir / self.today
+
+    def ensure_dirs(self) -> "Settings":
+        self.artifact_dir.mkdir(parents=True, exist_ok=True)
+        self.checkpoint_dir.mkdir(parents=True, exist_ok=True)
+        return self
+
+
+def md5(s: str) -> str:
+    """Stable content hash for artifact keys (reference: settings/package.scala:21-23)."""
+    return hashlib.md5(s.encode("utf-8")).hexdigest()
+
+
+_settings: Settings | None = None
+
+
+def get_settings() -> Settings:
+    global _settings
+    if _settings is None:
+        _settings = Settings()
+    return _settings
+
+
+def set_settings(settings: Settings) -> Settings:
+    global _settings
+    _settings = settings
+    return settings
+
+
+def reset_settings() -> None:
+    global _settings
+    _settings = None

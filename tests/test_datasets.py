@@ -1,0 +1,131 @@
+"""Dataset layer: star matrix, reindexing, bucketing, splits, artifacts."""
+
+import numpy as np
+import pytest
+
+from albedo_tpu.datasets import (
+    StarMatrix,
+    bucket_rows,
+    load_or_create_npz,
+    random_split_by_user,
+    synthetic_stars,
+)
+from albedo_tpu.datasets.ragged import bucket_shapes
+from albedo_tpu.datasets.split import sample_test_users
+
+
+def test_star_matrix_reindex_roundtrip():
+    m = StarMatrix.from_interactions(
+        raw_users=[100, 7, 100, 42], raw_items=[900, 900, 800, 700]
+    )
+    assert m.n_users == 3 and m.n_items == 3 and m.nnz == 4
+    assert sorted(m.user_ids.tolist()) == [7, 42, 100]
+    # raw -> dense -> raw roundtrip
+    dense = m.users_of(np.array([7, 42, 100, 9999]))
+    assert dense[3] == -1
+    np.testing.assert_array_equal(m.user_ids[dense[:3]], [7, 42, 100])
+
+
+def test_star_matrix_dedup_keeps_last():
+    m = StarMatrix.from_interactions(
+        raw_users=[1, 1, 1], raw_items=[5, 5, 6], vals=[1.0, 3.0, 2.0]
+    )
+    assert m.nnz == 2
+    d = m.dense()
+    assert d[0, m.items_of(np.array([5]))[0]] == 3.0
+
+
+def test_csr_csc_agree_with_dense():
+    m = synthetic_stars(n_users=50, n_items=40, mean_stars=5, seed=1)
+    d = m.dense()
+    indptr, cols, vals = m.csr()
+    for u in range(m.n_users):
+        seg = slice(indptr[u], indptr[u + 1])
+        np.testing.assert_allclose(d[u, cols[seg]], vals[seg])
+    indptr_c, rows, vals_c = m.csc()
+    for i in range(m.n_items):
+        seg = slice(indptr_c[i], indptr_c[i + 1])
+        np.testing.assert_allclose(d[rows[seg], i], vals_c[seg])
+
+
+def test_bucket_rows_covers_all_nonzeros():
+    m = synthetic_stars(n_users=300, n_items=120, mean_stars=8, seed=2)
+    indptr, cols, vals = m.csr()
+    buckets = bucket_rows(indptr, cols, vals, batch_size=64)
+    total = sum(int(b.mask.sum()) for b in buckets)
+    assert total == m.nnz
+    # Every nonzero row appears exactly once across buckets.
+    seen = np.concatenate([b.row_ids[b.row_ids >= 0] for b in buckets])
+    expected = np.nonzero(np.diff(indptr) > 0)[0]
+    np.testing.assert_array_equal(np.sort(seen), expected)
+    # Padded values are zero so confidence weights vanish on pads.
+    for b in buckets:
+        assert (b.val[~b.mask] == 0).all()
+    # Bounded shape count.
+    assert len(bucket_shapes(buckets)) <= 8
+
+
+def test_bucket_rows_max_len_truncates_to_tail():
+    indptr = np.array([0, 5])
+    cols = np.arange(5, dtype=np.int32)
+    vals = np.arange(5, dtype=np.float32) + 1
+    (b,) = bucket_rows(indptr, cols, vals, batch_size=4, max_len=3, len_multiple=2)
+    got = b.idx[0][b.mask[0]]
+    np.testing.assert_array_equal(got, [2, 3, 4])  # most recent tail kept
+
+
+def test_random_split_by_user_stratified():
+    m = synthetic_stars(n_users=200, n_items=100, mean_stars=10, seed=3)
+    train, test = random_split_by_user(m, test_ratio=0.25, seed=7)
+    assert train.nnz + test.nnz == m.nnz
+    counts = m.user_counts()
+    test_counts = test.user_counts()
+    train_counts = train.user_counts()
+    multi = counts > 1
+    # Every multi-star user keeps at least one train item and gets >=1 test item.
+    assert (train_counts[multi] >= 1).all()
+    assert (test_counts[multi] >= 1).all()
+    # Single-star users stay in train.
+    single = counts == 1
+    assert (test_counts[single] == 0).all()
+    # No overlap.
+    train_keys = set(zip(train.rows.tolist(), train.cols.tolist()))
+    test_keys = set(zip(test.rows.tolist(), test.cols.tolist()))
+    assert not (train_keys & test_keys)
+
+
+def test_split_deterministic():
+    m = synthetic_stars(n_users=100, n_items=60, mean_stars=6, seed=4)
+    t1, e1 = random_split_by_user(m, 0.2, seed=5)
+    t2, e2 = random_split_by_user(m, 0.2, seed=5)
+    np.testing.assert_array_equal(t1.rows, t2.rows)
+    np.testing.assert_array_equal(e1.cols, e2.cols)
+
+
+def test_sample_test_users_includes_canary():
+    m = synthetic_stars(n_users=100, n_items=50, mean_stars=5, seed=6)
+    users = sample_test_users(m, n=10, always_include=np.array([3]), seed=1)
+    assert 3 in users.tolist()
+    assert users.dtype == np.int32
+
+
+def test_load_or_create_npz_memoizes(tmp_path):
+    calls = []
+
+    def create():
+        calls.append(1)
+        return {"a": np.arange(5), "b": np.eye(2, dtype=np.float32)}
+
+    first = load_or_create_npz("factors-test", create)
+    second = load_or_create_npz("factors-test", create)
+    assert len(calls) == 1
+    np.testing.assert_array_equal(first["a"], second["a"])
+    np.testing.assert_array_equal(first["b"], second["b"])
+
+
+def test_synthetic_power_law_shape():
+    m = synthetic_stars(n_users=500, n_items=300, mean_stars=12, seed=8)
+    counts = m.item_counts()
+    top10 = np.sort(counts)[-10:].sum()
+    assert top10 > 0.1 * m.nnz  # popularity skew exists
+    assert (m.user_counts() >= 1).all()
