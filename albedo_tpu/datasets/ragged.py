@@ -54,6 +54,7 @@ def bucket_rows(
     batch_size: int = 1024,
     len_multiple: int = 8,
     max_len: int | None = None,
+    max_entries: int | None = None,
 ) -> list[Bucket]:
     """Chunk CSR rows into fixed-shape padded batches.
 
@@ -63,29 +64,39 @@ def bucket_rows(
     recent ``max_len`` entries, mirroring the reference's
     ``maxStarredReposCount`` cap (``LogisticRegressionRanker.scala:133``).
 
+    ``max_entries`` bounds ``B * L`` per bucket so the downstream
+    ``(B, L, rank)`` factor gather fits in device memory: long-row buckets get
+    proportionally (power-of-two) smaller batch sizes.
+
     Empty rows are skipped: ALS leaves those factors at their current value,
     matching cold-start behavior.
     """
-    n_rows = indptr.shape[0] - 1
     lengths = np.diff(indptr)
     nonempty = np.nonzero(lengths > 0)[0]
     # Stable sort by length keeps determinism across runs.
     order = nonempty[np.argsort(lengths[nonempty], kind="stable")]
 
     buckets: list[Bucket] = []
-    for start in range(0, order.shape[0], batch_size):
-        chunk = order[start : start + batch_size]
-        chunk_lens = lengths[chunk]
-        cap = int(chunk_lens.max())
-        if max_len is not None:
-            cap = min(cap, max_len)
-        pad_l = _pad_len(cap, len_multiple)
-        if max_len is not None:
-            # Don't let power-of-two rounding blow past the explicit work bound.
-            pad_l = min(pad_l, -(-max_len // len_multiple) * len_multiple)
-            pad_l = max(pad_l, cap)
+    start = 0
+    while start < order.shape[0]:
+        b = batch_size
+        # Shrink B (power-of-two steps, so shapes stay bounded) until the
+        # padded chunk respects the entry budget.
+        while True:
+            chunk = order[start : start + b]
+            cap = int(lengths[chunk].max())
+            if max_len is not None:
+                cap = min(cap, max_len)
+            pad_l = _pad_len(cap, len_multiple)
+            if max_len is not None:
+                # Don't let power-of-two rounding blow past the explicit bound.
+                pad_l = min(pad_l, -(-max_len // len_multiple) * len_multiple)
+                pad_l = max(pad_l, cap)
+            if max_entries is None or b * pad_l <= max_entries or b <= 1:
+                break
+            b //= 2
+        start += b
 
-        b = batch_size  # fixed B so at most len-bucket count of shapes exist
         idx = np.zeros((b, pad_l), dtype=np.int32)
         val = np.zeros((b, pad_l), dtype=np.float32)
         mask = np.zeros((b, pad_l), dtype=bool)

@@ -7,9 +7,12 @@ power-law user activity, and a low-rank preference structure that implicit ALS
 can recover — so ranking metrics behave like the reference's (ALS >> popularity
 baseline >> random, cf. BASELINE.md).
 
-Generation: scores S = U V^T + popularity logit; each user stars their
-Gumbel-top-k items, i.e. samples without replacement from
-softmax(S/temperature).
+Generation: scores S = signal_scale * U V^T / sqrt(rank) + popularity logit;
+each user stars their Gumbel-top-k items, i.e. samples without replacement from
+softmax(S / temperature). ``signal_scale`` sets how much personalization
+dominates popularity + Gumbel noise — at the default, a tuned ALS beats the
+popularity baseline by a wide margin, mirroring the reference's metric gap
+(0.052 vs 0.002, BASELINE.md).
 """
 
 from __future__ import annotations
@@ -25,6 +28,7 @@ def synthetic_stars(
     rank: int = 16,
     mean_stars: float = 30.0,
     popularity_alpha: float = 1.0,
+    signal_scale: float = 4.0,
     temperature: float = 1.0,
     seed: int = 42,
     chunk: int = 2048,
@@ -35,7 +39,8 @@ def synthetic_stars(
     (users +1_000_000, items +5_000_000) so tests exercise the reindex maps.
     """
     rng = np.random.default_rng(seed)
-    scale = 1.0 / np.sqrt(rank)
+    # Unit-variance per-pair preference signal, scaled by signal_scale.
+    scale = np.sqrt(signal_scale / np.sqrt(rank))
     u_fac = rng.normal(0.0, scale, size=(n_users, rank)).astype(np.float32)
     v_fac = rng.normal(0.0, scale, size=(n_items, rank)).astype(np.float32)
 
@@ -54,7 +59,7 @@ def synthetic_stars(
     cols_parts: list[np.ndarray] = []
     for lo in range(0, n_users, chunk):
         hi = min(lo + chunk, n_users)
-        scores = u_fac[lo:hi] @ v_fac.T / temperature + pop_logit
+        scores = (u_fac[lo:hi] @ v_fac.T + pop_logit) / temperature
         gumbel = rng.gumbel(size=scores.shape).astype(np.float32)
         noisy = scores + gumbel
         kmax = int(n_stars[lo:hi].max())

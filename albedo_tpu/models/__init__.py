@@ -1,0 +1,10 @@
+"""JAX model estimators: ImplicitALS, LogisticRegression, Word2Vec.
+
+Replaces the Spark MLlib estimators the reference calls
+(``ml.recommendation.ALS``, ``ml.classification.LogisticRegression``,
+``ml.feature.Word2Vec``).
+"""
+
+from albedo_tpu.models.als import ALSModel, ImplicitALS
+
+__all__ = ["ALSModel", "ImplicitALS"]

@@ -1,0 +1,126 @@
+"""Implicit-feedback ALS estimator and model.
+
+Reference parity: Spark MLlib ``ALS`` as configured by
+``ALSRecommenderBuilder.scala:46-58`` — implicitPrefs=true, rank=50,
+regParam=0.5, alpha=40, maxIter=26, seed=42, coldStartStrategy="drop". The
+north-star NDCG@30 (0.05209, BASELINE.md) comes from exactly those settings.
+
+TPU-first architecture: instead of MLlib's shuffled in/out blocks, each
+iteration is two bucketed half-sweeps of fixed-shape normal-equation solves on
+device (``albedo_tpu.ops.als``); the ratings live on device as padded buckets
+built once per fit. Iteration order matches MLlib: item factors update first,
+then user factors.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from albedo_tpu.datasets.ragged import bucket_rows
+from albedo_tpu.datasets.star_matrix import StarMatrix
+from albedo_tpu.ops.als import als_half_sweep
+from albedo_tpu.ops.topk import topk_scores
+
+
+@dataclasses.dataclass
+class ALSModel:
+    """Trained factor matrices, indexed by dense user/item indices."""
+
+    user_factors: np.ndarray  # (n_users, rank) float32
+    item_factors: np.ndarray  # (n_items, rank) float32
+    rank: int
+
+    def predict(self, rows: np.ndarray, cols: np.ndarray) -> np.ndarray:
+        u = self.user_factors[np.asarray(rows)]
+        v = self.item_factors[np.asarray(cols)]
+        return np.sum(u * v, axis=1)
+
+    def recommend(
+        self,
+        user_indices: np.ndarray,
+        k: int = 30,
+        exclude_idx: np.ndarray | None = None,
+        item_block: int = 4096,
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """Top-k items for the given users: (scores (U, k), item_idx (U, k))."""
+        uf = jnp.asarray(self.user_factors[np.asarray(user_indices)])
+        vf = jnp.asarray(self.item_factors)
+        excl = None if exclude_idx is None else jnp.asarray(exclude_idx)
+        vals, idx = topk_scores(uf, vf, k=k, exclude_idx=excl, item_block=item_block)
+        return np.asarray(vals), np.asarray(idx)
+
+    def to_arrays(self) -> dict[str, np.ndarray]:
+        return {
+            "user_factors": self.user_factors,
+            "item_factors": self.item_factors,
+            "rank": np.int64(self.rank),
+        }
+
+    @staticmethod
+    def from_arrays(arrays: dict[str, np.ndarray]) -> "ALSModel":
+        return ALSModel(
+            user_factors=np.asarray(arrays["user_factors"], dtype=np.float32),
+            item_factors=np.asarray(arrays["item_factors"], dtype=np.float32),
+            rank=int(arrays["rank"]),
+        )
+
+
+@dataclasses.dataclass
+class ImplicitALS:
+    """Alternating least squares for implicit feedback on a device mesh.
+
+    Defaults mirror the reference's flagship config
+    (``ALSRecommenderBuilder.scala:46-58``).
+    """
+
+    rank: int = 50
+    reg_param: float = 0.5
+    alpha: float = 40.0
+    max_iter: int = 26
+    seed: int = 42
+    batch_size: int = 1024
+    max_entries: int = 1 << 21  # B*L budget per bucket (gather memory bound)
+    max_len: int | None = None
+
+    def fit(self, matrix: StarMatrix, callback: Any | None = None) -> ALSModel:
+        """Train factors on the (single-device) default backend.
+
+        ``callback(iteration, user_factors, item_factors)`` if given is invoked
+        after each full sweep (host arrays; for monitoring/tests).
+        """
+        user_buckets = bucket_rows(
+            *matrix.csr(),
+            batch_size=self.batch_size,
+            max_entries=self.max_entries,
+            max_len=self.max_len,
+        )
+        item_buckets = bucket_rows(
+            *matrix.csc(),
+            batch_size=self.batch_size,
+            max_entries=self.max_entries,
+            max_len=self.max_len,
+        )
+
+        key = jax.random.PRNGKey(self.seed)
+        ukey, ikey = jax.random.split(key)
+        scale = 1.0 / np.sqrt(self.rank)
+        user_f = jax.random.normal(ukey, (matrix.n_users, self.rank), jnp.float32) * scale
+        item_f = jax.random.normal(ikey, (matrix.n_items, self.rank), jnp.float32) * scale
+
+        for it in range(self.max_iter):
+            # MLlib order: item factors first (from user factors), then users.
+            item_f = als_half_sweep(user_f, item_f, item_buckets, self.reg_param, self.alpha)
+            user_f = als_half_sweep(item_f, user_f, user_buckets, self.reg_param, self.alpha)
+            if callback is not None:
+                callback(it, np.asarray(user_f), np.asarray(item_f))
+
+        return ALSModel(
+            user_factors=np.asarray(user_f),
+            item_factors=np.asarray(item_f),
+            rank=self.rank,
+        )

@@ -1,0 +1,119 @@
+"""Implicit-feedback ALS normal-equation kernels.
+
+The math (Hu-Koren-Volinsky implicit ALS, with Spark MLlib's conventions so the
+reference's NDCG is reproducible — SURVEY.md section 7 hard part (b)):
+
+- confidence ``c_ui = 1 + alpha * r_ui``; preference ``p_ui = 1`` where ``r > 0``
+- user solve:  ``x_u = (YtY + Y_u^T diag(alpha r_u) Y_u + lambda n_u I)^-1
+  Y_u^T (1 + alpha r_u)``
+  where ``n_u`` is the user's nonzero count — MLlib scales ``regParam`` by the
+  explicit rating count (ALS-WR scaling), see ``ALSRecommenderBuilder.scala:46-58``
+  for the hyperparameters this must match.
+
+The reference executes this inside Spark MLlib as shuffled user/item blocks
+with per-block LAPACK Cholesky on executors. Here each half-sweep is a set of
+fixed-shape bucket solves: gather ``Y[idx] -> (B, L, k)``, one fused einsum for
+the Gramian correction, batched Cholesky, scatter back — all on the MXU, no
+shuffle. Buckets come from ``albedo_tpu.datasets.bucket_rows``.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from albedo_tpu.datasets.ragged import Bucket
+
+
+def gramian(factors: jax.Array) -> jax.Array:
+    """``F^T F`` in float32 — the shared ``YtY`` term of every implicit solve."""
+    return factors.T @ factors
+
+
+@functools.partial(jax.jit, donate_argnames=("target",))
+def solve_bucket(
+    source: jax.Array,   # (n_source, k) fixed side's factors
+    yty: jax.Array,      # (k, k) gramian of `source`
+    target: jax.Array,   # (n_target, k) factors being updated (donated)
+    row_ids: jax.Array,  # (B,) int32 target rows, -1 on padding slots
+    idx: jax.Array,      # (B, L) int32 indices into `source`
+    val: jax.Array,      # (B, L) float32 ratings, 0 on padding
+    mask: jax.Array,     # (B, L) bool
+    reg: jax.Array,      # () float32 regParam
+    alpha: jax.Array,    # () float32 confidence scale
+) -> jax.Array:
+    """One normal-equation solve for a padded bucket of rows; returns updated
+    ``target`` with solved rows scattered in."""
+    k = source.shape[1]
+    gathered = source[idx]                      # (B, L, k)
+    c1 = alpha * val                            # (B, L); 0 on padding
+    w = jnp.where(mask, 1.0 + c1, 0.0)          # b-vector weights
+
+    # A_b = YtY + sum_l c1 * y y^T + reg * n_b * I
+    corr = jnp.einsum("blk,bl,blm->bkm", gathered, c1, gathered)
+    n_b = mask.sum(axis=1).astype(jnp.float32)
+    eye = jnp.eye(k, dtype=source.dtype)
+    a_mat = yty[None] + corr + (reg * n_b)[:, None, None] * eye
+    b_vec = jnp.einsum("blk,bl->bk", gathered, w)
+
+    chol = jnp.linalg.cholesky(a_mat)
+    solved = jax.scipy.linalg.cho_solve((chol, True), b_vec[..., None])[..., 0]
+
+    # Padding slots scatter out of bounds and are dropped.
+    safe_rows = jnp.where(row_ids < 0, target.shape[0], row_ids)
+    return target.at[safe_rows].set(solved, mode="drop")
+
+
+def als_half_sweep(
+    source: jax.Array,
+    target: jax.Array,
+    buckets: list[Bucket],
+    reg: float,
+    alpha: float,
+) -> jax.Array:
+    """Update every (nonempty) row of ``target`` from fixed ``source`` factors.
+
+    One compiled kernel per distinct bucket shape (O(log max_len) shapes).
+    """
+    yty = gramian(source)
+    reg_arr = jnp.float32(reg)
+    alpha_arr = jnp.float32(alpha)
+    for b in buckets:
+        target = solve_bucket(
+            source, yty, target,
+            jnp.asarray(b.row_ids), jnp.asarray(b.idx),
+            jnp.asarray(b.val), jnp.asarray(b.mask),
+            reg_arr, alpha_arr,
+        )
+    return target
+
+
+def implicit_loss(
+    user_factors: jax.Array,
+    item_factors: jax.Array,
+    rows: jax.Array,
+    cols: jax.Array,
+    vals: jax.Array,
+    reg: float,
+    alpha: float,
+) -> jax.Array:
+    """The exact implicit-ALS objective (for tests/monitoring; O(U*I) — small
+    data only).
+
+    ``sum_ui c_ui (p_ui - x_u . y_i)^2 + reg * (sum_u n_u |x_u|^2 + sum_i n_i |y_i|^2)``
+    """
+    scores = user_factors @ item_factors.T
+    conf = jnp.ones_like(scores)
+    pref = jnp.zeros_like(scores)
+    conf = conf.at[rows, cols].add(alpha * vals)
+    pref = pref.at[rows, cols].set(jnp.where(vals > 0, 1.0, 0.0))
+    data_term = (conf * (pref - scores) ** 2).sum()
+
+    n_u = jnp.zeros(user_factors.shape[0]).at[rows].add(1.0)
+    n_i = jnp.zeros(item_factors.shape[0]).at[cols].add(1.0)
+    reg_term = (n_u * (user_factors**2).sum(axis=1)).sum() + (
+        n_i * (item_factors**2).sum(axis=1)
+    ).sum()
+    return data_term + reg * reg_term

@@ -1,0 +1,81 @@
+"""Blocked user x item scoring with streaming top-k.
+
+Replaces the reference's retrieval hot loop (``recommenders/ALSRecommender.scala:21-61``):
+blockify both factor tables (4096 rows/block), cross-join blocks, score each
+pair with ``F2jBLAS.sdot``, and keep a per-user ``BoundedPriorityQueue``. Here
+the block cross-product is a ``lax.scan`` over item blocks: each step is one
+``(U, k) @ (k, B)`` MXU GEMM followed by a merge of the running ``(U, K)``
+top-k with the block's scores via ``lax.top_k`` — no materialized U x I score
+matrix (SURVEY.md section 7 hard part (c)).
+
+Optionally masks out each user's already-seen items (the PySpark track's
+``recommend_items`` exclusion, ``albedo_toolkit/common.py:47-71``).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, static_argnames=("k", "item_block"))
+def topk_scores(
+    user_factors: jax.Array,          # (U, r)
+    item_factors: jax.Array,          # (I, r)
+    k: int,
+    exclude_idx: jax.Array | None = None,  # (U, E) int32 item indices, -1 = none
+    item_block: int = 4096,
+) -> tuple[jax.Array, jax.Array]:
+    """Return ``(scores (U, k), item_indices (U, k))`` of the top-k items/user.
+
+    Items are processed in ``item_block``-sized chunks; the running top-k is
+    merged with each chunk, so peak memory is ``O(U * (k + item_block))``.
+    ``exclude_idx`` rows list per-user items to mask to -inf (padded with -1).
+    """
+    n_users, rank = user_factors.shape
+    n_items = item_factors.shape[0]
+
+    n_blocks = -(-n_items // item_block)
+    padded = n_blocks * item_block
+    items_pad = jnp.zeros((padded, rank), dtype=item_factors.dtype)
+    items_pad = items_pad.at[:n_items].set(item_factors)
+    item_blocks = items_pad.reshape(n_blocks, item_block, rank)
+
+    neg_inf = jnp.asarray(-jnp.inf, dtype=user_factors.dtype)
+    init_vals = jnp.full((n_users, k), neg_inf, dtype=user_factors.dtype)
+    init_idx = jnp.full((n_users, k), -1, dtype=jnp.int32)
+
+    u_rows = jnp.arange(n_users)[:, None]
+
+    def step(carry, inp):
+        top_vals, top_idx = carry
+        block_id, block_factors = inp
+        start = block_id * item_block
+        scores = user_factors @ block_factors.T            # (U, B) on the MXU
+        # Mask item-padding tail.
+        global_ids = start + jnp.arange(item_block, dtype=jnp.int32)
+        scores = jnp.where(global_ids[None, :] < n_items, scores, neg_inf)
+        if exclude_idx is not None:
+            local = exclude_idx - start                     # (U, E)
+            oob = (local < 0) | (local >= item_block) | (exclude_idx < 0)
+            local = jnp.where(oob, item_block, local)       # drop out of bounds
+            hit = jnp.zeros((n_users, item_block), dtype=bool)
+            hit = hit.at[u_rows, local].set(True, mode="drop")
+            scores = jnp.where(hit, neg_inf, scores)
+
+        merged_vals = jnp.concatenate([top_vals, scores], axis=1)
+        merged_idx = jnp.concatenate(
+            [top_idx, jnp.broadcast_to(global_ids[None, :], scores.shape)], axis=1
+        )
+        new_vals, pos = jax.lax.top_k(merged_vals, k)
+        new_idx = jnp.take_along_axis(merged_idx, pos, axis=1)
+        return (new_vals, new_idx), None
+
+    (top_vals, top_idx), _ = jax.lax.scan(
+        step,
+        (init_vals, init_idx),
+        (jnp.arange(n_blocks, dtype=jnp.int32), item_blocks),
+    )
+    return top_vals, top_idx

@@ -15,6 +15,14 @@ flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
 
+# The environment may pre-import jax (e.g. a sitecustomize on PYTHONPATH) with
+# a hardware platform pinned; env vars alone are then too late. The config
+# update works post-import as long as no backend has been initialized yet.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+assert jax.default_backend() == "cpu"
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 

@@ -1,0 +1,144 @@
+"""Implicit ALS: kernel parity vs a dense numpy reference, objective descent,
+and structure recovery on planted synthetic data."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from albedo_tpu.datasets import StarMatrix, bucket_rows, synthetic_stars  # noqa: E402
+from albedo_tpu.models.als import ALSModel, ImplicitALS  # noqa: E402
+from albedo_tpu.ops.als import als_half_sweep, implicit_loss  # noqa: E402
+
+
+def numpy_half_sweep(source, target, indptr, indices, vals, reg, alpha):
+    """Dense reference for one implicit-ALS half-sweep (MLlib conventions)."""
+    out = target.copy()
+    yty = source.T @ source
+    k = source.shape[1]
+    for r in range(indptr.shape[0] - 1):
+        lo, hi = indptr[r], indptr[r + 1]
+        if hi == lo:
+            continue
+        y = source[indices[lo:hi]]            # (n, k)
+        c1 = alpha * vals[lo:hi]
+        a_mat = yty + (y * c1[:, None]).T @ y + reg * (hi - lo) * np.eye(k)
+        b_vec = ((1.0 + c1)[:, None] * y).sum(axis=0)
+        out[r] = np.linalg.solve(a_mat, b_vec)
+    return out
+
+
+@pytest.fixture(scope="module")
+def small_matrix():
+    return synthetic_stars(n_users=120, n_items=80, mean_stars=8, seed=11)
+
+
+def test_half_sweep_matches_numpy(small_matrix):
+    m = small_matrix
+    rng = np.random.default_rng(0)
+    user_f = rng.normal(0, 0.1, (m.n_users, 8)).astype(np.float32)
+    item_f = rng.normal(0, 0.1, (m.n_items, 8)).astype(np.float32)
+    reg, alpha = 0.3, 10.0
+
+    indptr, cols, vals = m.csr()
+    expected = numpy_half_sweep(item_f, user_f, indptr, cols, vals, reg, alpha)
+
+    buckets = bucket_rows(indptr, cols, vals, batch_size=32)
+    got = np.asarray(
+        als_half_sweep(jnp.asarray(item_f), jnp.asarray(user_f), buckets, reg, alpha)
+    )
+    np.testing.assert_allclose(got, expected, rtol=2e-3, atol=2e-4)
+
+
+def test_half_sweep_respects_memory_budget(small_matrix):
+    m = small_matrix
+    indptr, cols, vals = m.csr()
+    buckets = bucket_rows(indptr, cols, vals, batch_size=64, max_entries=512)
+    # Budget is honored for any row that itself fits in the budget.
+    assert all(b.idx.size <= 512 or b.idx.shape[0] == 1 for b in buckets)
+    # Budgeted buckets still cover every nonzero exactly once.
+    assert sum(int(b.mask.sum()) for b in buckets) == m.nnz
+
+
+def test_objective_monotone_descent(small_matrix):
+    m = small_matrix
+    losses = []
+
+    def track(it, uf, vf):
+        losses.append(
+            float(
+                implicit_loss(
+                    jnp.asarray(uf), jnp.asarray(vf),
+                    jnp.asarray(m.rows), jnp.asarray(m.cols), jnp.asarray(m.vals),
+                    reg=0.5, alpha=10.0,
+                )
+            )
+        )
+
+    ImplicitALS(rank=8, reg_param=0.5, alpha=10.0, max_iter=6, seed=1).fit(
+        m, callback=track
+    )
+    # ALS is coordinate descent on the exact objective: monotone non-increasing.
+    assert all(b <= a * (1 + 1e-5) for a, b in zip(losses, losses[1:])), losses
+    assert losses[-1] < losses[0]
+
+
+def test_fit_deterministic(small_matrix):
+    als = ImplicitALS(rank=4, max_iter=2, seed=7, alpha=5.0)
+    m1 = als.fit(small_matrix)
+    m2 = als.fit(small_matrix)
+    np.testing.assert_allclose(m1.user_factors, m2.user_factors, rtol=1e-5, atol=1e-6)
+
+
+def test_recovers_planted_structure():
+    """ALS scores must rank a user's held-out items above random items."""
+    m = synthetic_stars(n_users=300, n_items=150, mean_stars=20, seed=21)
+    from albedo_tpu.datasets import random_split_by_user
+
+    train, test = random_split_by_user(m, test_ratio=0.2, seed=3)
+    model = ImplicitALS(rank=16, reg_param=0.1, alpha=40.0, max_iter=8, seed=0).fit(train)
+
+    rng = np.random.default_rng(5)
+    neg_items = rng.integers(0, m.n_items, size=test.nnz).astype(np.int32)
+    # A random negative that the user starred in train is legitimately scored
+    # high by a good model — exclude those pairs from the probe.
+    collide = (train.dense() > 0)[test.rows, neg_items]
+    pos = model.predict(test.rows[~collide], test.cols[~collide])
+    neg = model.predict(test.rows[~collide], neg_items[~collide])
+    auc_proxy = float((pos > neg).mean())
+
+    counts = train.item_counts().astype(float)
+    pop_auc = float(
+        (counts[test.cols[~collide]] > counts[neg_items[~collide]]).mean()
+    )
+    # Held-out positives outscore random negatives, and personalization beats
+    # the popularity baseline (the reference's metric gap, BASELINE.md).
+    assert auc_proxy > 0.7, auc_proxy
+    assert auc_proxy > pop_auc, (auc_proxy, pop_auc)
+
+
+def test_model_roundtrip(small_matrix, tmp_path):
+    model = ImplicitALS(rank=4, max_iter=1).fit(small_matrix)
+    arrays = model.to_arrays()
+    loaded = ALSModel.from_arrays(arrays)
+    np.testing.assert_array_equal(loaded.user_factors, model.user_factors)
+    assert loaded.rank == model.rank
+
+
+def test_empty_user_keeps_init_factor():
+    # User 0 has no interactions: its factor should stay at initialization.
+    m = StarMatrix(
+        user_ids=np.array([1, 2, 3]),
+        item_ids=np.array([10, 20]),
+        rows=np.array([1, 2, 2], dtype=np.int32),
+        cols=np.array([0, 0, 1], dtype=np.int32),
+        vals=np.ones(3, dtype=np.float32),
+    )
+    als = ImplicitALS(rank=4, max_iter=2, seed=3)
+    model = als.fit(m)
+    key = jax.random.PRNGKey(3)
+    ukey, _ = jax.random.split(key)
+    init = np.asarray(jax.random.normal(ukey, (3, 4), jnp.float32)) / np.sqrt(4)
+    np.testing.assert_allclose(model.user_factors[0], init[0], rtol=1e-6)
+    assert not np.allclose(model.user_factors[1], init[1])
