@@ -7,7 +7,7 @@ feeding happens in ``albedo_tpu.ops``.
 
 from albedo_tpu.datasets.artifacts import load_or_create, load_or_create_df, load_or_create_npz
 from albedo_tpu.datasets.ragged import Bucket, bucket_rows
-from albedo_tpu.datasets.split import random_split_by_user
+from albedo_tpu.datasets.split import random_split_by_user, sample_test_users
 from albedo_tpu.datasets.star_matrix import StarMatrix
 from albedo_tpu.datasets.synthetic import synthetic_stars
 
@@ -19,5 +19,6 @@ __all__ = [
     "load_or_create_df",
     "load_or_create_npz",
     "random_split_by_user",
+    "sample_test_users",
     "synthetic_stars",
 ]

@@ -1,0 +1,158 @@
+"""Evaluator parity tests: vectorized metrics vs a slow literal transcription
+of Spark MLlib's RankingMetrics semantics (the reference's metric engine)."""
+
+import numpy as np
+import pytest
+
+from albedo_tpu.datasets.star_matrix import StarMatrix
+from albedo_tpu.evaluators import (
+    RankingEvaluator,
+    UserItems,
+    area_under_roc,
+    mean_average_precision,
+    ndcg_at_k,
+    precision_at_k,
+    user_actual_items,
+    user_items_from_pairs,
+)
+
+
+def _mllib_ndcg(pred, lab, k):
+    lab_set = set(lab)
+    if not lab_set:
+        return 0.0
+    n = min(max(len(pred), len(lab_set)), k)
+    dcg = max_dcg = 0.0
+    for i in range(n):
+        gain = 1.0 / np.log(i + 2)
+        if i < len(pred) and pred[i] in lab_set:
+            dcg += gain
+        if i < len(lab_set):
+            max_dcg += gain
+    return dcg / max_dcg
+
+
+def _mllib_precision(pred, lab, k):
+    lab_set = set(lab)
+    n = min(len(pred), k)
+    cnt = sum(1 for i in range(n) if pred[i] in lab_set)
+    return cnt / k
+
+
+def _mllib_map(pred, lab):
+    lab_set = set(lab)
+    if not lab_set:
+        return 0.0
+    cnt = 0
+    prec_sum = 0.0
+    for i, p in enumerate(pred):
+        if p in lab_set:
+            cnt += 1
+            prec_sum += cnt / (i + 1)
+    return prec_sum / len(lab_set)
+
+
+def _random_lists(rng, n_queries, max_pred, max_lab, n_items=50):
+    preds, labs = [], []
+    for _ in range(n_queries):
+        np_ = rng.integers(0, max_pred + 1)
+        nl = rng.integers(0, max_lab + 1)
+        preds.append(rng.choice(n_items, size=np_, replace=False))
+        labs.append(rng.choice(n_items, size=nl, replace=False))
+    return preds, labs
+
+
+def _pad(lists, width):
+    out = np.full((len(lists), width), -1, dtype=np.int32)
+    for i, x in enumerate(lists):
+        out[i, : len(x)] = x
+    return out
+
+
+@pytest.mark.parametrize("k", [1, 5, 30])
+def test_metrics_match_mllib_semantics(rng, k):
+    preds, labs = _random_lists(rng, 40, max_pred=k + 4, max_lab=k + 4)
+    # Reference slices both sides to k before RankingMetrics (RankingEvaluator.scala:96-97).
+    preds_k = [p[:k] for p in preds]
+    labs_k = [l[:k] for l in labs]
+    pred_arr, lab_arr = _pad(preds_k, k), _pad(labs_k, k)
+
+    want_ndcg = np.mean([_mllib_ndcg(p, l, k) for p, l in zip(preds_k, labs_k)])
+    want_prec = np.mean([_mllib_precision(p, l, k) for p, l in zip(preds_k, labs_k)])
+    want_map = np.mean([_mllib_map(p, l) for p, l in zip(preds_k, labs_k)])
+
+    assert ndcg_at_k(pred_arr, lab_arr, k) == pytest.approx(want_ndcg, abs=1e-6)
+    assert precision_at_k(pred_arr, lab_arr, k) == pytest.approx(want_prec, abs=1e-6)
+    assert mean_average_precision(pred_arr, lab_arr, k) == pytest.approx(want_map, abs=1e-6)
+
+
+def test_ndcg_hand_computed():
+    # One user, perfect first hit then a miss then a hit; 2 relevant items.
+    pred = np.array([[7, 3, 9]], dtype=np.int32)
+    actual = np.array([[7, 9, -1]], dtype=np.int32)
+    g = lambda i: 1.0 / np.log(i + 2)  # noqa: E731
+    want = (g(0) + g(2)) / (g(0) + g(1))
+    assert ndcg_at_k(pred, actual, 3) == pytest.approx(want, abs=1e-6)
+    # Perfect ranking -> 1.0
+    assert ndcg_at_k(np.array([[7, 9]]), np.array([[9, 7]]), 2) == pytest.approx(1.0)
+
+
+def test_evaluator_inner_join_and_k():
+    # Users 1 and 2 in both; user 3 only predicted; user 4 only actual.
+    predicted = UserItems(
+        users=np.array([1, 2, 3], dtype=np.int32),
+        items=np.array([[10, 11], [20, 21], [30, 31]], dtype=np.int32),
+    )
+    actual = UserItems(
+        users=np.array([1, 2, 4], dtype=np.int32),
+        items=np.array([[10, -1], [99, -1], [40, -1]], dtype=np.int32),
+    )
+    ev = RankingEvaluator(metric_name="precision@k", k=2)
+    # user1: 1 hit / k=2 -> 0.5; user2: 0 hits -> 0. Mean = 0.25.
+    assert ev.evaluate(predicted, actual) == pytest.approx(0.25)
+
+
+def test_user_items_from_pairs_orders_and_truncates():
+    users = np.array([5, 5, 5, 8])
+    items = np.array([100, 101, 102, 200])
+    score = np.array([0.1, 0.9, 0.5, 1.0])
+    ui = user_items_from_pairs(users, items, order_key=score, k=2)
+    assert ui.users.tolist() == [5, 8]
+    assert ui.items[0].tolist() == [101, 102]  # by score desc, truncated to 2
+    assert ui.items[1].tolist() == [200, -1]
+
+
+def test_user_actual_items_recency():
+    m = StarMatrix.from_interactions(
+        raw_users=np.array([1, 1, 1, 2]),
+        raw_items=np.array([10, 20, 30, 10]),
+    )
+    # Insertion order is the recency proxy: latest first.
+    ui = user_actual_items(m, k=2)
+    it = {10: 0, 20: 1, 30: 2}  # dense item indices (sorted raw ids)
+    assert ui.items[0].tolist() == [it[30], it[20]]
+
+
+def test_auc_pairwise_reference(rng):
+    scores = rng.normal(size=200)
+    labels = (rng.random(200) < 0.3).astype(np.float64)
+    scores[labels > 0] += 0.8
+    # O(n^2) pairwise definition with half-credit ties.
+    pos, neg = scores[labels > 0], scores[labels <= 0]
+    cmp = (pos[:, None] > neg[None, :]).sum() + 0.5 * (pos[:, None] == neg[None, :]).sum()
+    want = cmp / (len(pos) * len(neg))
+    assert area_under_roc(scores, labels) == pytest.approx(want, abs=1e-9)
+
+
+def test_auc_weighted_ties(rng):
+    scores = np.round(rng.normal(size=300), 1)  # force ties
+    labels = (rng.random(300) < 0.4).astype(np.float64)
+    weights = rng.integers(1, 4, size=300).astype(np.float64)
+    # Weighted pairwise reference.
+    pos, neg = labels > 0.5, labels <= 0.5
+    sp, wp = scores[pos], weights[pos]
+    sn, wn = scores[neg], weights[neg]
+    num = (wp[:, None] * wn[None, :] * (sp[:, None] > sn[None, :])).sum()
+    num += 0.5 * (wp[:, None] * wn[None, :] * (sp[:, None] == sn[None, :])).sum()
+    want = num / (wp.sum() * wn.sum())
+    assert area_under_roc(scores, labels, weights) == pytest.approx(want, abs=1e-9)
