@@ -86,9 +86,12 @@ class ImplicitALS:
     batch_size: int = 1024
     max_entries: int = 1 << 21  # B*L budget per bucket (gather memory bound)
     max_len: int | None = None
+    # Optional jax.sharding.Mesh: shard each bucket's batch dim over the mesh's
+    # "data" axis (albedo_tpu.parallel.als) instead of single-device sweeps.
+    mesh: Any | None = None
 
     def fit(self, matrix: StarMatrix, callback: Any | None = None) -> ALSModel:
-        """Train factors on the (single-device) default backend.
+        """Train factors on the default backend, or sharded over ``self.mesh``.
 
         ``callback(iteration, user_factors, item_factors)`` if given is invoked
         after each full sweep (host arrays; for monitoring/tests).
@@ -106,16 +109,25 @@ class ImplicitALS:
             max_len=self.max_len,
         )
 
+        sweep = None
+        if self.mesh is not None:
+            from albedo_tpu.parallel.als import ShardedALSSweep
+
+            sweep = ShardedALSSweep(self.mesh)
+            user_buckets = sweep.prepare(user_buckets)
+            item_buckets = sweep.prepare(item_buckets)
+
         key = jax.random.PRNGKey(self.seed)
         ukey, ikey = jax.random.split(key)
         scale = 1.0 / np.sqrt(self.rank)
         user_f = jax.random.normal(ukey, (matrix.n_users, self.rank), jnp.float32) * scale
         item_f = jax.random.normal(ikey, (matrix.n_items, self.rank), jnp.float32) * scale
 
+        half = sweep.half_sweep if sweep is not None else als_half_sweep
         for it in range(self.max_iter):
             # MLlib order: item factors first (from user factors), then users.
-            item_f = als_half_sweep(user_f, item_f, item_buckets, self.reg_param, self.alpha)
-            user_f = als_half_sweep(item_f, user_f, user_buckets, self.reg_param, self.alpha)
+            item_f = half(user_f, item_f, item_buckets, self.reg_param, self.alpha)
+            user_f = half(item_f, user_f, user_buckets, self.reg_param, self.alpha)
             if callback is not None:
                 callback(it, np.asarray(user_f), np.asarray(item_f))
 

@@ -32,6 +32,34 @@ def gramian(factors: jax.Array) -> jax.Array:
     return factors.T @ factors
 
 
+def bucket_solve_body(
+    source: jax.Array,   # (n_source, k) fixed side's factors
+    yty: jax.Array,      # (k, k) gramian of `source`
+    idx: jax.Array,      # (B, L) int32 indices into `source`
+    val: jax.Array,      # (B, L) float32 ratings, 0 on padding
+    mask: jax.Array,     # (B, L) bool
+    reg: jax.Array,      # () float32 regParam
+    alpha: jax.Array,    # () float32 confidence scale
+) -> jax.Array:
+    """The normal-equation solve for a padded bucket: gather → fused Gramian
+    correction → batched Cholesky. Shared by the single-device and shard_map'd
+    paths (``parallel.als``), so a parity fix lands in both."""
+    k = source.shape[1]
+    gathered = source[idx]                      # (B, L, k)
+    c1 = alpha * val                            # (B, L); 0 on padding
+    w = jnp.where(mask, 1.0 + c1, 0.0)          # b-vector weights
+
+    # A_b = YtY + sum_l c1 * y y^T + reg * n_b * I
+    corr = jnp.einsum("blk,bl,blm->bkm", gathered, c1, gathered)
+    n_b = mask.sum(axis=1).astype(jnp.float32)
+    eye = jnp.eye(k, dtype=source.dtype)
+    a_mat = yty[None] + corr + (reg * n_b)[:, None, None] * eye
+    b_vec = jnp.einsum("blk,bl->bk", gathered, w)
+
+    chol = jnp.linalg.cholesky(a_mat)
+    return jax.scipy.linalg.cho_solve((chol, True), b_vec[..., None])[..., 0]
+
+
 @functools.partial(jax.jit, donate_argnames=("target",))
 def solve_bucket(
     source: jax.Array,   # (n_source, k) fixed side's factors
@@ -46,21 +74,7 @@ def solve_bucket(
 ) -> jax.Array:
     """One normal-equation solve for a padded bucket of rows; returns updated
     ``target`` with solved rows scattered in."""
-    k = source.shape[1]
-    gathered = source[idx]                      # (B, L, k)
-    c1 = alpha * val                            # (B, L); 0 on padding
-    w = jnp.where(mask, 1.0 + c1, 0.0)          # b-vector weights
-
-    # A_b = YtY + sum_l c1 * y y^T + reg * n_b * I
-    corr = jnp.einsum("blk,bl,blm->bkm", gathered, c1, gathered)
-    n_b = mask.sum(axis=1).astype(jnp.float32)
-    eye = jnp.eye(k, dtype=source.dtype)
-    a_mat = yty[None] + corr + (reg * n_b)[:, None, None] * eye
-    b_vec = jnp.einsum("blk,bl->bk", gathered, w)
-
-    chol = jnp.linalg.cholesky(a_mat)
-    solved = jax.scipy.linalg.cho_solve((chol, True), b_vec[..., None])[..., 0]
-
+    solved = bucket_solve_body(source, yty, idx, val, mask, reg, alpha)
     # Padding slots scatter out of bounds and are dropped.
     safe_rows = jnp.where(row_ids < 0, target.shape[0], row_ids)
     return target.at[safe_rows].set(solved, mode="drop")

@@ -1,0 +1,28 @@
+"""Device-mesh parallelism: the TPU-native replacement for the reference's
+Spark driver/executor runtime (SURVEY.md sections 2.5, 7).
+
+- ``mesh`` — named-axis mesh construction (``data`` x ``item``) and sharding
+  helpers.
+- ``als`` — shard_map'd data-parallel ALS bucket solves + psum Gramian for
+  sharded factor storage.
+- ``topk`` — item-axis-sharded retrieval with k-per-device candidate merge.
+"""
+
+from albedo_tpu.parallel.mesh import (  # noqa: F401
+    DATA_AXIS,
+    ITEM_AXIS,
+    make_mesh,
+    pad_rows_to,
+    replicated,
+    row_sharded,
+)
+from albedo_tpu.parallel.als import (  # noqa: F401
+    ShardedALSSweep,
+    make_sharded_solver,
+    pad_bucket,
+    sharded_gramian,
+)
+from albedo_tpu.parallel.topk import (  # noqa: F401
+    make_sharded_topk,
+    sharded_topk_scores,
+)

@@ -1,0 +1,138 @@
+"""Sharded implicit-ALS sweeps over a device mesh.
+
+The reference's ALS scales by Spark MLlib's shuffled in/out factor blocks with
+per-block LAPACK solves on executors (``ALSRecommenderBuilder.scala:46-58``
+just calls ``als.fit``; the block machinery is inside MLlib). TPU-native
+replacement, two composable pieces:
+
+1. **Data-parallel bucket solves** (`make_sharded_solver`): each padded bucket's
+   batch dimension is sharded over the mesh's ``data`` axis with ``shard_map``
+   — every device runs the same fixed-shape gather → Gramian-correction einsum
+   → batched-Cholesky pipeline on its slice of the rows, the direct analogue of
+   MLlib's per-executor block solves but with no shuffle: the solved rows are
+   re-assembled by XLA (an all-gather over ICI) and scattered into the factor
+   table.
+
+2. **psum Gramian** (`sharded_gramian`): when a factor table is stored sharded
+   over devices (rows split on ``data``), the shared ``YtY`` term of every
+   implicit solve is the sum of per-shard partial Gramians — one ``(k, k)``
+   ``psum`` over ICI, the pattern SURVEY.md section 7 step 3 prescribes (ALX).
+
+Factor tables are replicated by default: at albedo scale (≤ millions of rows ×
+rank 50, float32) a full table is ≤ a few hundred MB — far below HBM — and
+replication makes the per-bucket arbitrary-index gather local. The sharded
+storage path exists for larger-than-HBM factor tables.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from albedo_tpu.datasets.ragged import Bucket
+from albedo_tpu.ops.als import bucket_solve_body
+from albedo_tpu.parallel.mesh import DATA_AXIS, pad_rows_to
+
+
+def pad_bucket(b: Bucket, multiple: int) -> Bucket:
+    """Pad a bucket's batch dim to a device-count multiple (padding slots have
+    ``row_ids == -1`` and zero weight, so they solve garbage that is dropped on
+    scatter)."""
+    if b.row_ids.shape[0] % multiple == 0:
+        return b
+    return Bucket(
+        row_ids=pad_rows_to(b.row_ids, multiple, fill=-1),
+        idx=pad_rows_to(b.idx, multiple),
+        val=pad_rows_to(b.val, multiple),
+        mask=pad_rows_to(b.mask, multiple),
+    )
+
+
+def sharded_gramian(mesh: Mesh, axis: str = DATA_AXIS):
+    """``F^T F`` for a row-sharded factor table: local partial Gramian + psum."""
+
+    @jax.jit
+    @functools.partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=P(axis, None),
+        out_specs=P(),
+    )
+    def gramian(local_factors: jax.Array) -> jax.Array:
+        return jax.lax.psum(local_factors.T @ local_factors, axis)
+
+    return gramian
+
+
+def make_sharded_solver(mesh: Mesh, axis: str = DATA_AXIS):
+    """Build the jitted sharded bucket solver for this mesh.
+
+    The returned function has the same signature/semantics as
+    ``ops.als.solve_bucket`` but runs the per-row solves data-parallel across
+    ``axis``. Bucket batch dims must be divisible by the axis size
+    (see ``pad_bucket``).
+    """
+    n_shards = mesh.shape[axis]
+
+    local_solve = shard_map(
+        _local_bucket_solve,
+        mesh=mesh,
+        in_specs=(P(), P(), P(axis), P(axis, None), P(axis, None), P(axis, None), P(), P()),
+        out_specs=P(axis),
+    )
+
+    @functools.partial(jax.jit, donate_argnames=("target",))
+    def solve_bucket_sharded(source, yty, target, row_ids, idx, val, mask, reg, alpha):
+        if row_ids.shape[0] % n_shards:
+            raise ValueError(
+                f"bucket batch {row_ids.shape[0]} not divisible by {n_shards} shards"
+            )
+        solved = local_solve(source, yty, row_ids, idx, val, mask, reg, alpha)
+        # Scatter back into the (replicated) target; XLA inserts the all-gather
+        # of the row-sharded `solved` over ICI.
+        safe_rows = jnp.where(row_ids < 0, target.shape[0], row_ids)
+        return target.at[safe_rows].set(solved, mode="drop")
+
+    return solve_bucket_sharded
+
+
+def _local_bucket_solve(source, yty, row_ids, idx, val, mask, reg, alpha):
+    """Per-device slice of a bucket solve; math shared with the single-device
+    path via ``ops.als.bucket_solve_body``."""
+    del row_ids  # only needed for the scatter, outside the shard
+    return bucket_solve_body(source, yty, idx, val, mask, reg, alpha)
+
+
+class ShardedALSSweep:
+    """Stateful wrapper: pre-pads buckets for a mesh and runs half-sweeps.
+
+    Drop-in for ``ops.als.als_half_sweep`` in ``ImplicitALS.fit`` when a mesh
+    is supplied.
+    """
+
+    def __init__(self, mesh: Mesh, axis: str = DATA_AXIS):
+        self.mesh = mesh
+        self.axis = axis
+        self._solver = make_sharded_solver(mesh, axis)
+        self._n = mesh.shape[axis]
+
+    def prepare(self, buckets: list[Bucket]) -> list[Bucket]:
+        return [pad_bucket(b, self._n) for b in buckets]
+
+    def half_sweep(self, source, target, buckets, reg, alpha):
+        yty = source.T @ source
+        reg_arr = jnp.float32(reg)
+        alpha_arr = jnp.float32(alpha)
+        for b in buckets:
+            target = self._solver(
+                source, yty, target,
+                jnp.asarray(b.row_ids), jnp.asarray(b.idx),
+                jnp.asarray(b.val), jnp.asarray(b.mask),
+                reg_arr, alpha_arr,
+            )
+        return target

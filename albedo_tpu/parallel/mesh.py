@@ -1,0 +1,75 @@
+"""Device-mesh construction and sharding helpers.
+
+The reference's distributed runtime is Spark (driver + executors, shuffle,
+broadcast), configured externally via ``spark-submit`` flags (``Makefile:96-107``)
+— albedo itself contains no communication code. The TPU-native replacement is a
+``jax.sharding.Mesh`` over the chip slice with named axes:
+
+- ``"data"`` — batch/row parallelism: bucket rows of the ALS normal-equation
+  solves, user rows of retrieval, example rows of LR gradient batches. The
+  analogue of Spark data-parallel executors.
+- ``"item"`` — item-axis (model) parallelism: item-factor shards for retrieval
+  scoring and Gramian accumulation (SURVEY.md section 2.5: "sharding the item
+  dimension of the Gramian/score matrix across chips").
+
+Collectives ride ICI within a slice (psum for Gramians/gradients, all_gather
+for top-k candidate merges), replacing Spark shuffle/broadcast/collect.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+ITEM_AXIS = "item"
+
+
+def make_mesh(
+    n_devices: int | None = None,
+    data: int | None = None,
+    item: int = 1,
+    devices: list | None = None,
+) -> Mesh:
+    """Build a ``(data, item)`` mesh over the first ``n_devices`` devices.
+
+    By default all devices go on the ``data`` axis — the right layout while
+    factor tables fit replicated (rank-50 factors for albedo-scale data are
+    ~hundreds of MB). Give ``item > 1`` to shard the item axis as well.
+    """
+    devs = devices if devices is not None else jax.devices()
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    n = len(devs)
+    if data is None:
+        if n % item != 0:
+            raise ValueError(f"{n} devices not divisible by item={item}")
+        data = n // item
+    if data * item != n:
+        raise ValueError(f"mesh {data}x{item} != {n} devices")
+    grid = np.asarray(devs).reshape(data, item)
+    return Mesh(grid, axis_names=(DATA_AXIS, ITEM_AXIS))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def row_sharded(mesh: Mesh, axis: str = DATA_AXIS) -> NamedSharding:
+    """Shard axis 0 across ``axis``, replicate the rest."""
+    return NamedSharding(mesh, P(axis))
+
+
+def device_put_sharded_rows(x, mesh: Mesh, axis: str = DATA_AXIS):
+    return jax.device_put(x, row_sharded(mesh, axis))
+
+
+def pad_rows_to(x: np.ndarray, multiple: int, fill=0) -> np.ndarray:
+    """Pad axis 0 up to a multiple (for even sharding); fill with ``fill``."""
+    n = x.shape[0]
+    target = -(-n // multiple) * multiple
+    if target == n:
+        return x
+    pad = np.full((target - n, *x.shape[1:]), fill, dtype=x.dtype)
+    return np.concatenate([x, pad], axis=0)
