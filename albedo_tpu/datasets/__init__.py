@@ -10,15 +10,27 @@ from albedo_tpu.datasets.ragged import Bucket, bucket_rows
 from albedo_tpu.datasets.split import random_split_by_user, sample_test_users
 from albedo_tpu.datasets.star_matrix import StarMatrix
 from albedo_tpu.datasets.synthetic import synthetic_stars
+from albedo_tpu.datasets.synthetic_tables import synthetic_tables
+from albedo_tpu.datasets.tables import (
+    RawTables,
+    load_or_create_raw_tables,
+    load_raw_tables,
+    popular_repos,
+)
 
 __all__ = [
     "Bucket",
+    "RawTables",
     "StarMatrix",
     "bucket_rows",
     "load_or_create",
     "load_or_create_df",
     "load_or_create_npz",
+    "load_or_create_raw_tables",
+    "load_raw_tables",
+    "popular_repos",
     "random_split_by_user",
     "sample_test_users",
     "synthetic_stars",
+    "synthetic_tables",
 ]

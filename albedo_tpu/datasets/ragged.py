@@ -116,6 +116,17 @@ def bucket_rows(
     return buckets
 
 
+def device_bucket(b: Bucket, sharding=None) -> Bucket:
+    """One-time host->device upload of a bucket's arrays (optionally with a
+    ``jax.sharding.Sharding`` layout, e.g. row-sharded over a mesh)."""
+    import jax
+
+    put = (lambda x: jax.device_put(x, sharding)) if sharding is not None else jax.device_put
+    return Bucket(
+        row_ids=put(b.row_ids), idx=put(b.idx), val=put(b.val), mask=put(b.mask)
+    )
+
+
 def bucket_shapes(buckets: list[Bucket]) -> list[tuple[int, int]]:
     """Distinct shapes (== number of XLA compilations the sweep will trigger)."""
     return sorted({b.shape for b in buckets})

@@ -1,0 +1,275 @@
+"""Raw entity tables: schemas, loaders, and the popular-repo view.
+
+Reference parity: the typed case-class schemas (``schemas/package.scala:4-70``)
+and ``DatasetUtils``'s JDBC loaders which rename the Django columns into the
+``user_*`` / ``repo_*`` conventions (``utils/DatasetUtils.scala:52-160``). The
+MySQL service is replaced by file ingest (CSV/parquet directory) or sqlite (the
+``albedo_tpu.store`` acquisition layer), memoized through the date-keyed
+artifact cache exactly like ``loadOrCreateDataFrame``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from pathlib import Path
+from typing import Callable
+
+import numpy as np
+import pandas as pd
+
+from albedo_tpu.datasets.artifacts import load_or_create_df
+from albedo_tpu.datasets.star_matrix import StarMatrix
+
+# Column -> pandas dtype, mirroring schemas/package.scala. Timestamps are
+# float64 epoch seconds (XLA-friendly; formatted only at the display edge).
+USER_INFO_SCHEMA: dict[str, str] = {
+    "user_id": "int64",
+    "user_login": "string",
+    "user_account_type": "string",
+    "user_name": "string",
+    "user_company": "string",
+    "user_blog": "string",
+    "user_location": "string",
+    "user_email": "string",
+    "user_bio": "string",
+    "user_public_repos_count": "int64",
+    "user_public_gists_count": "int64",
+    "user_followers_count": "int64",
+    "user_following_count": "int64",
+    "user_created_at": "float64",
+    "user_updated_at": "float64",
+}
+
+REPO_INFO_SCHEMA: dict[str, str] = {
+    "repo_id": "int64",
+    "repo_owner_id": "int64",
+    "repo_owner_username": "string",
+    "repo_owner_type": "string",
+    "repo_name": "string",
+    "repo_full_name": "string",
+    "repo_description": "string",
+    "repo_language": "string",
+    "repo_created_at": "float64",
+    "repo_updated_at": "float64",
+    "repo_pushed_at": "float64",
+    "repo_homepage": "string",
+    "repo_size": "int64",
+    "repo_stargazers_count": "int64",
+    "repo_forks_count": "int64",
+    "repo_subscribers_count": "int64",
+    "repo_is_fork": "bool",
+    "repo_has_issues": "bool",
+    "repo_has_projects": "bool",
+    "repo_has_downloads": "bool",
+    "repo_has_wiki": "bool",
+    "repo_has_pages": "bool",
+    "repo_open_issues_count": "int64",
+    "repo_topics": "string",  # comma-separated, as the Django ListTextField stores it
+}
+
+STARRING_SCHEMA: dict[str, str] = {
+    "user_id": "int64",
+    "repo_id": "int64",
+    "starred_at": "float64",
+    "starring": "float64",
+}
+
+RELATION_SCHEMA: dict[str, str] = {
+    "from_user_id": "int64",
+    "to_user_id": "int64",
+    "relation": "string",
+}
+
+# Django table name -> (renames, target schema): the ingest-side equivalent of
+# DatasetUtils' withColumnRenamed chains (utils/DatasetUtils.scala:58-133).
+_DJANGO_USER_RENAMES = {
+    "id": "user_id",
+    "login": "user_login",
+    "account_type": "user_account_type",
+    "name": "user_name",
+    "company": "user_company",
+    "blog": "user_blog",
+    "location": "user_location",
+    "email": "user_email",
+    "bio": "user_bio",
+    "public_repos": "user_public_repos_count",
+    "public_gists": "user_public_gists_count",
+    "followers": "user_followers_count",
+    "following": "user_following_count",
+    "created_at": "user_created_at",
+    "updated_at": "user_updated_at",
+}
+_DJANGO_REPO_RENAMES = {
+    "id": "repo_id",
+    "owner_id": "repo_owner_id",
+    "owner_username": "repo_owner_username",
+    "owner_type": "repo_owner_type",
+    "name": "repo_name",
+    "full_name": "repo_full_name",
+    "description": "repo_description",
+    "language": "repo_language",
+    "created_at": "repo_created_at",
+    "updated_at": "repo_updated_at",
+    "pushed_at": "repo_pushed_at",
+    "homepage": "repo_homepage",
+    "size": "repo_size",
+    "stargazers_count": "repo_stargazers_count",
+    "forks_count": "repo_forks_count",
+    "subscribers_count": "repo_subscribers_count",
+    "fork": "repo_is_fork",
+    "has_issues": "repo_has_issues",
+    "has_projects": "repo_has_projects",
+    "has_downloads": "repo_has_downloads",
+    "has_wiki": "repo_has_wiki",
+    "has_pages": "repo_has_pages",
+    "open_issues_count": "repo_open_issues_count",
+    "topics": "repo_topics",
+}
+
+
+def conform(df: pd.DataFrame, schema: dict[str, str], renames: dict[str, str] | None = None) -> pd.DataFrame:
+    """Rename + select + cast a raw frame to a schema; missing string columns
+    become empty, missing numerics 0 (the builders impute anyway)."""
+    if renames:
+        df = df.rename(columns={k: v for k, v in renames.items() if k in df.columns})
+    out = {}
+    for col, dtype in schema.items():
+        if col in df.columns:
+            s = df[col]
+        elif dtype == "string":
+            s = pd.Series([""] * len(df))
+        elif dtype == "bool":
+            s = pd.Series([False] * len(df))
+        else:
+            s = pd.Series(np.zeros(len(df)))
+        if dtype == "string":
+            s = s.astype("string").fillna("")
+        elif dtype == "bool":
+            s = s.fillna(False).astype(bool)
+        else:
+            s = pd.to_numeric(s, errors="coerce").fillna(0).astype(dtype)
+        out[col] = s.reset_index(drop=True)
+    return pd.DataFrame(out)
+
+
+@dataclasses.dataclass
+class RawTables:
+    """The four entity tables every builder consumes (L1 of SURVEY.md §1)."""
+
+    user_info: pd.DataFrame
+    repo_info: pd.DataFrame
+    starring: pd.DataFrame
+    relation: pd.DataFrame
+
+    def conformed(self) -> "RawTables":
+        return RawTables(
+            user_info=conform(self.user_info, USER_INFO_SCHEMA),
+            repo_info=conform(self.repo_info, REPO_INFO_SCHEMA),
+            starring=conform(self.starring, STARRING_SCHEMA),
+            relation=conform(self.relation, RELATION_SCHEMA),
+        )
+
+    def star_matrix(self) -> StarMatrix:
+        """The implicit-rating matrix (``loadRawStarringDS`` adds
+        ``starring = 1.0``; ``DatasetUtils.scala:111-121``), interactions kept
+        in starred_at order so truncation keeps the most recent."""
+        s = self.starring.sort_values("starred_at", kind="stable")
+        return StarMatrix.from_interactions(
+            raw_users=s["user_id"].to_numpy(np.int64),
+            raw_items=s["repo_id"].to_numpy(np.int64),
+            vals=np.ones(len(s), dtype=np.float32),
+        )
+
+
+def popular_repos(
+    repo_info: pd.DataFrame, min_stars: int = 1000, max_stars: int = 290000
+) -> pd.DataFrame:
+    """``loadPopularRepoDF`` parity: repos with stars in [1000, 290000], most
+    starred first (``utils/DatasetUtils.scala:148-160``)."""
+    sel = repo_info[
+        repo_info["repo_stargazers_count"].between(min_stars, max_stars)
+    ]
+    return (
+        sel[["repo_id", "repo_stargazers_count", "repo_created_at"]]
+        .sort_values("repo_stargazers_count", ascending=False, kind="stable")
+        .reset_index(drop=True)
+    )
+
+
+_TABLE_FILES = {
+    "user_info": (USER_INFO_SCHEMA, _DJANGO_USER_RENAMES, ("user_info", "app_userinfo")),
+    "repo_info": (REPO_INFO_SCHEMA, _DJANGO_REPO_RENAMES, ("repo_info", "app_repoinfo")),
+    "starring": (STARRING_SCHEMA, None, ("starring", "app_repostarring")),
+    "relation": (RELATION_SCHEMA, None, ("relation", "app_userrelation")),
+}
+
+
+def load_raw_tables(source: str | Path) -> RawTables:
+    """Ingest the four tables from a directory of CSV/parquet files or a
+    sqlite database (the acquisition layer's store).
+
+    File naming accepts either this package's names (``user_info.csv``) or the
+    Django table names (``app_userinfo.csv``), mirroring the JDBC table names
+    in ``DatasetUtils`` (``utils/DatasetUtils.scala:58,80,116,128``).
+    """
+    source = Path(source)
+    frames: dict[str, pd.DataFrame] = {}
+    if source.is_file() and source.suffix in (".db", ".sqlite", ".sqlite3"):
+        import sqlite3
+
+        with sqlite3.connect(source) as conn:
+            names = {
+                r[0]
+                for r in conn.execute(
+                    "SELECT name FROM sqlite_master WHERE type='table'"
+                )
+            }
+            for key, (_, _, aliases) in _TABLE_FILES.items():
+                for alias in aliases:
+                    if alias in names:
+                        frames[key] = pd.read_sql_query(f"SELECT * FROM {alias}", conn)
+                        break
+    elif source.is_dir():
+        for key, (_, _, aliases) in _TABLE_FILES.items():
+            for alias in aliases:
+                for ext, reader in (
+                    (".parquet", pd.read_parquet),
+                    (".csv", pd.read_csv),
+                ):
+                    p = source / f"{alias}{ext}"
+                    if p.exists():
+                        frames[key] = _read(reader, p)
+                        break
+                if key in frames:
+                    break
+    else:
+        raise FileNotFoundError(f"no such table source: {source}")
+
+    out = {}
+    for key, (schema, renames, _) in _TABLE_FILES.items():
+        df = frames.get(key, pd.DataFrame())
+        out[key] = conform(df, schema, renames)
+    return RawTables(**out)
+
+
+def _read(reader: Callable, path: Path) -> pd.DataFrame:
+    df = reader(path)
+    return df
+
+
+def load_or_create_raw_tables(create: Callable[[], RawTables]) -> RawTables:
+    """Date-keyed memoization of the conformed tables (the ``rawUserInfoDF.parquet``
+    etc. caching idiom, ``utils/DatasetUtils.scala:52-133``)."""
+    tables: dict[str, pd.DataFrame] = {}
+    made: dict[str, RawTables] = {}
+
+    def _get() -> RawTables:
+        if "value" not in made:
+            made["value"] = create().conformed()
+        return made["value"]
+
+    for key in _TABLE_FILES:
+        tables[key] = load_or_create_df(
+            f"raw_{key}.parquet", lambda key=key: getattr(_get(), key)
+        )
+    return RawTables(**tables)

@@ -21,20 +21,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from albedo_tpu.datasets.ragged import Bucket, bucket_rows
+from albedo_tpu.datasets.ragged import bucket_rows, device_bucket
 from albedo_tpu.datasets.star_matrix import StarMatrix
 from albedo_tpu.ops.als import als_half_sweep
 from albedo_tpu.ops.topk import topk_scores
-
-
-def _device_bucket(b: Bucket) -> Bucket:
-    """One-time host->device upload of a bucket's arrays."""
-    return Bucket(
-        row_ids=jax.device_put(b.row_ids),
-        idx=jax.device_put(b.idx),
-        val=jax.device_put(b.val),
-        mask=jax.device_put(b.mask),
-    )
 
 
 @dataclasses.dataclass
@@ -129,8 +119,8 @@ class ImplicitALS:
         else:
             # Upload every bucket once; the sweeps reuse the device copies
             # across all max_iter iterations instead of re-transferring.
-            user_buckets = [_device_bucket(b) for b in user_buckets]
-            item_buckets = [_device_bucket(b) for b in item_buckets]
+            user_buckets = [device_bucket(b) for b in user_buckets]
+            item_buckets = [device_bucket(b) for b in item_buckets]
 
         key = jax.random.PRNGKey(self.seed)
         ukey, ikey = jax.random.split(key)

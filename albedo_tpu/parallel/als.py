@@ -34,7 +34,7 @@ import numpy as np
 from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
-from albedo_tpu.datasets.ragged import Bucket
+from albedo_tpu.datasets.ragged import Bucket, device_bucket
 from albedo_tpu.ops.als import bucket_solve_body
 from albedo_tpu.parallel.mesh import DATA_AXIS, pad_rows_to, row_sharded
 
@@ -125,18 +125,7 @@ class ShardedALSSweep:
         """Pad to the shard count and upload once, already laid out row-sharded
         over the mesh (no per-iteration transfer or reshard)."""
         rows = row_sharded(self.mesh, self.axis)
-        out = []
-        for b in buckets:
-            p = pad_bucket(b, self._n)
-            out.append(
-                Bucket(
-                    row_ids=jax.device_put(p.row_ids, rows),
-                    idx=jax.device_put(p.idx, rows),
-                    val=jax.device_put(p.val, rows),
-                    mask=jax.device_put(p.mask, rows),
-                )
-            )
-        return out
+        return [device_bucket(pad_bucket(b, self._n), rows) for b in buckets]
 
     def half_sweep(self, source, target, buckets, reg, alpha):
         yty = source.T @ source

@@ -1,0 +1,21 @@
+"""Text utilities: CJK-aware word extraction and field cleaners.
+
+Reference parity: ``closures/StringFunctions.scala`` and the cleaning UDFs in
+``closures/UDFs.scala:32-78``.
+"""
+
+from albedo_tpu.text.strings import (
+    clean_company,
+    clean_location,
+    extract_email_domain,
+    extract_words,
+    extract_words_include_cjk,
+)
+
+__all__ = [
+    "clean_company",
+    "clean_location",
+    "extract_email_domain",
+    "extract_words",
+    "extract_words_include_cjk",
+]

@@ -1,0 +1,152 @@
+"""Raw-table layer: schemas, ingest, popular view, and the string cleaners.
+
+Reference parity anchors: ``schemas/package.scala``, ``utils/DatasetUtils.scala``
+(loaders + popular query), ``closures/UDFs.scala:32-78`` (cleaners).
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from albedo_tpu.datasets import (
+    load_or_create_raw_tables,
+    load_raw_tables,
+    popular_repos,
+    synthetic_tables,
+)
+from albedo_tpu.datasets.tables import (
+    REPO_INFO_SCHEMA,
+    STARRING_SCHEMA,
+    USER_INFO_SCHEMA,
+    conform,
+)
+from albedo_tpu.text import (
+    clean_company,
+    clean_location,
+    extract_email_domain,
+    extract_words_include_cjk,
+)
+
+
+@pytest.fixture(scope="module")
+def tables():
+    return synthetic_tables(n_users=150, n_items=120, mean_stars=12, seed=3)
+
+
+def test_schemas_complete(tables):
+    # Column parity with schemas/package.scala (15 user cols, 24 repo cols).
+    assert len(USER_INFO_SCHEMA) == 15
+    assert len(REPO_INFO_SCHEMA) == 24
+    assert list(tables.user_info.columns) == list(USER_INFO_SCHEMA)
+    assert list(tables.repo_info.columns) == list(REPO_INFO_SCHEMA)
+    assert list(tables.starring.columns) == list(STARRING_SCHEMA)
+
+
+def test_star_matrix_roundtrip(tables):
+    m = tables.star_matrix()
+    assert m.n_users == tables.starring["user_id"].nunique()
+    assert m.n_items == tables.starring["repo_id"].nunique()
+    assert m.nnz == len(tables.starring.drop_duplicates(["user_id", "repo_id"]))
+    # starring column is the implicit 1.0 rating
+    assert (tables.starring["starring"] == 1.0).all()
+
+
+def test_starred_at_monotonic_per_user(tables):
+    s = tables.starring.sort_values(["user_id", "starred_at"])
+    g = s.groupby("user_id")["starred_at"]
+    assert (g.diff().dropna() >= 0).all()
+
+
+def test_popular_repos_range(tables):
+    pop = popular_repos(tables.repo_info, min_stars=100, max_stars=49_000)
+    assert (pop["repo_stargazers_count"].between(100, 49_000)).all()
+    assert (pop["repo_stargazers_count"].diff().dropna() <= 0).all()
+
+
+def test_conform_fills_missing():
+    df = pd.DataFrame({"user_id": [1, 2], "user_login": ["a", None]})
+    out = conform(df, USER_INFO_SCHEMA)
+    assert out["user_login"].tolist() == ["a", ""]
+    assert (out["user_followers_count"] == 0).all()
+    assert out["user_created_at"].dtype == np.float64
+
+
+def test_ingest_csv_dir_django_names(tables, tmp_path):
+    # Django table-name aliases, like the JDBC reads in DatasetUtils.
+    tables.user_info.rename(
+        columns={
+            "user_id": "id", "user_login": "login", "user_account_type": "account_type",
+            "user_name": "name", "user_company": "company", "user_blog": "blog",
+            "user_location": "location", "user_email": "email", "user_bio": "bio",
+            "user_public_repos_count": "public_repos",
+            "user_public_gists_count": "public_gists",
+            "user_followers_count": "followers", "user_following_count": "following",
+            "user_created_at": "created_at", "user_updated_at": "updated_at",
+        }
+    ).to_csv(tmp_path / "app_userinfo.csv", index=False)
+    tables.starring.to_csv(tmp_path / "app_repostarring.csv", index=False)
+    got = load_raw_tables(tmp_path)
+    assert got.user_info["user_login"].tolist() == tables.user_info["user_login"].tolist()
+    assert len(got.starring) == len(tables.starring)
+    assert len(got.repo_info) == 0  # missing file -> empty conformed frame
+
+
+def test_ingest_sqlite(tables, tmp_path):
+    import sqlite3
+
+    db = tmp_path / "albedo.db"
+    with sqlite3.connect(db) as conn:
+        tables.starring.to_sql("app_repostarring", conn, index=False)
+        tables.repo_info.to_sql("repo_info", conn, index=False)
+    got = load_raw_tables(db)
+    assert len(got.starring) == len(tables.starring)
+    assert got.repo_info["repo_id"].tolist() == tables.repo_info["repo_id"].tolist()
+
+
+def test_load_or_create_raw_tables_cache_hit(tables):
+    calls = []
+
+    def create():
+        calls.append(1)
+        return tables
+
+    first = load_or_create_raw_tables(create)
+    second = load_or_create_raw_tables(lambda: (_ for _ in ()).throw(AssertionError))
+    assert len(calls) == 1  # one conformed build serves all four table artifacts
+    assert first.starring["user_id"].tolist() == second.starring["user_id"].tolist()
+
+
+# --- string cleaners ---------------------------------------------------------
+
+
+def test_clean_company_examples():
+    assert clean_company("@BigCorp Inc.") == "bigcorp"
+    assert clean_company("tinystartup.io") == "tinystartup"
+    assert clean_company("Formerly @MegaSoft") == "megasoft"
+    assert clean_company("ACME Co Ltd") == "acme"
+    assert clean_company("") == "__empty"
+    assert clean_company("!!!") == "__empty"
+
+
+def test_clean_location_takes_city():
+    assert clean_location("Taipei, Taiwan") == "taipei"
+    assert clean_location("New York City") == "new york"
+    assert clean_location("") == "__empty"
+
+
+def test_cjk_words_kept():
+    words = extract_words_include_cjk("機械学習 rocks deep-learning")
+    assert "機械学習" in words and "rocks" in words and "deep-learning" in words
+    assert clean_location("東京") == "東京"
+
+
+def test_email_domain():
+    assert extract_email_domain("someone@example.com") == "example.com"
+    assert extract_email_domain("no-at-sign") == "no-at-sign"
+
+
+def test_synthetic_tables_deterministic():
+    a = synthetic_tables(n_users=40, n_items=30, seed=9)
+    b = synthetic_tables(n_users=40, n_items=30, seed=9)
+    pd.testing.assert_frame_equal(a.repo_info, b.repo_info)
+    pd.testing.assert_frame_equal(a.starring, b.starring)
