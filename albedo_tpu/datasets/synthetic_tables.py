@@ -164,7 +164,6 @@ def synthetic_tables(
     # --- starring ------------------------------------------------------------
     # starred_at increases with position in each user's interaction list, so
     # "most recent" slices are deterministic.
-    starred_at = np.zeros(matrix.nnz)
     indptr, cols, _ = matrix.csr()
     rows_sorted = np.repeat(np.arange(n_users), np.diff(indptr))
     base_t = u_created[rows_sorted]

@@ -127,6 +127,17 @@ _DJANGO_REPO_RENAMES = {
 }
 
 
+_FALSY_STRINGS = {"", "0", "false", "f", "no", "n", "none", "null", "nan"}
+
+
+def _to_bool(v) -> bool:
+    if isinstance(v, str):
+        return v.strip().lower() not in _FALSY_STRINGS
+    if v is None or (isinstance(v, float) and np.isnan(v)):
+        return False
+    return bool(v)
+
+
 def conform(df: pd.DataFrame, schema: dict[str, str], renames: dict[str, str] | None = None) -> pd.DataFrame:
     """Rename + select + cast a raw frame to a schema; missing string columns
     become empty, missing numerics 0 (the builders impute anyway)."""
@@ -145,7 +156,9 @@ def conform(df: pd.DataFrame, schema: dict[str, str], renames: dict[str, str] | 
         if dtype == "string":
             s = s.astype("string").fillna("")
         elif dtype == "bool":
-            s = s.fillna(False).astype(bool)
+            # CSV/sqlite ingest may carry booleans as strings or 0/1 ints;
+            # a bare astype(bool) would turn "false"/"0" into True.
+            s = s.map(_to_bool).fillna(False).astype(bool)
         else:
             s = pd.to_numeric(s, errors="coerce").fillna(0).astype(dtype)
         out[col] = s.reset_index(drop=True)
@@ -238,7 +251,7 @@ def load_raw_tables(source: str | Path) -> RawTables:
                 ):
                     p = source / f"{alias}{ext}"
                     if p.exists():
-                        frames[key] = _read(reader, p)
+                        frames[key] = reader(p)
                         break
                 if key in frames:
                     break
@@ -252,24 +265,26 @@ def load_raw_tables(source: str | Path) -> RawTables:
     return RawTables(**out)
 
 
-def _read(reader: Callable, path: Path) -> pd.DataFrame:
-    df = reader(path)
-    return df
-
-
 def load_or_create_raw_tables(create: Callable[[], RawTables]) -> RawTables:
     """Date-keyed memoization of the conformed tables (the ``rawUserInfoDF.parquet``
-    etc. caching idiom, ``utils/DatasetUtils.scala:52-133``)."""
-    tables: dict[str, pd.DataFrame] = {}
-    made: dict[str, RawTables] = {}
+    caching idiom, ``utils/DatasetUtils.scala:52-133``). All four tables live in
+    ONE artifact so a killed job can never resume with a torn set (user_info
+    from one ``create()`` invocation, starring from another)."""
+    import pickle
 
-    def _get() -> RawTables:
-        if "value" not in made:
-            made["value"] = create().conformed()
-        return made["value"]
+    from albedo_tpu.datasets.artifacts import load_or_create
 
-    for key in _TABLE_FILES:
-        tables[key] = load_or_create_df(
-            f"raw_{key}.parquet", lambda key=key: getattr(_get(), key)
-        )
-    return RawTables(**tables)
+    def _create() -> dict[str, pd.DataFrame]:
+        t = create().conformed()
+        return {key: getattr(t, key) for key in _TABLE_FILES}
+
+    def _save(path, frames: dict[str, pd.DataFrame]) -> None:
+        with open(path, "wb") as f:
+            pickle.dump(frames, f)
+
+    def _load(path) -> dict[str, pd.DataFrame]:
+        with open(path, "rb") as f:
+            return pickle.load(f)
+
+    frames = load_or_create("raw_tables.pkl", _create, _save, _load)
+    return RawTables(**frames)

@@ -1,0 +1,85 @@
+"""Negative sampling for the ranker: popular-minus-positives per user.
+
+Reference parity: ``transformers/NegativeBalancer.scala:13-119`` — per user,
+take the (popularity-ordered) popular-item set minus the user's positives,
+emit the first ``negativePositiveRatio * n_positives`` of them with label
+``negativeValue`` and the sentinel timestamp 1999-07-01 (:107), then union with
+the positives. The LinkedHashSet preserves popularity order, so negatives are
+deterministically the most popular items the user has NOT starred — same here
+(SURVEY.md §7 hard part (f)).
+
+The RDD ``aggregateByKey`` over a broadcast set becomes one vectorized numpy
+pass on the host.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pandas as pd
+
+from albedo_tpu.features.pipeline import Transformer
+
+# 1999-07-01T00:00:00Z, the reference's sentinel (NegativeBalancer.scala:107).
+SENTINEL_TIME = 930787200.0
+
+
+class NegativeBalancer(Transformer):
+    def __init__(
+        self,
+        popular_items: np.ndarray,
+        user_col: str = "user_id",
+        item_col: str = "repo_id",
+        time_col: str = "starred_at",
+        label_col: str = "starring",
+        negative_value: float = 0.0,
+        negative_positive_ratio: float = 1.0,
+    ):
+        # Popularity-ordered (most popular first), like the broadcast
+        # LinkedHashSet built from loadPopularRepoDF (LogisticRegressionRanker.scala:250-255).
+        self.popular_items = np.asarray(popular_items, dtype=np.int64)
+        self.user_col = user_col
+        self.item_col = item_col
+        self.time_col = time_col
+        self.label_col = label_col
+        self.negative_value = negative_value
+        self.negative_positive_ratio = negative_positive_ratio
+
+    def transform(self, df: pd.DataFrame) -> pd.DataFrame:
+        self.require_cols(df, [self.user_col, self.item_col, self.time_col, self.label_col])
+        pop = self.popular_items
+        users = df[self.user_col].to_numpy(np.int64)
+        items = df[self.item_col].to_numpy(np.int64)
+
+        neg_users, neg_items = [], []
+        order = np.argsort(users, kind="stable")
+        bounds = np.nonzero(np.diff(users[order]))[0] + 1
+        for chunk in np.split(order, bounds):
+            u = users[chunk[0]]
+            positives = set(items[chunk].tolist())
+            need = int(len(positives) * self.negative_positive_ratio)
+            if need == 0:
+                continue
+            # Walk the popularity order, skipping positives.
+            out = []
+            for it in pop:
+                if int(it) in positives:
+                    continue
+                out.append(it)
+                if len(out) >= need:
+                    break
+            neg_users.extend([u] * len(out))
+            neg_items.extend(out)
+
+        negative = pd.DataFrame(
+            {
+                self.user_col: np.asarray(neg_users, dtype=np.int64),
+                self.item_col: np.asarray(neg_items, dtype=np.int64),
+                self.time_col: np.full(len(neg_items), SENTINEL_TIME),
+                self.label_col: np.full(len(neg_items), self.negative_value),
+            }
+        )
+        out_df = pd.concat(
+            [df[[self.user_col, self.item_col, self.time_col, self.label_col]], negative],
+            ignore_index=True,
+        )
+        return out_df

@@ -1,0 +1,45 @@
+"""User x repo cross features.
+
+Reference parity: ``transformers/UserRepoTransformer.scala:10-50`` +
+``closures/UDFs.scala:80-87`` — position and count of the repo's language
+within the user's recent-repo-language list.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pandas as pd
+
+from albedo_tpu.features.pipeline import Transformer
+
+
+class UserRepoTransformer(Transformer):
+    def __init__(
+        self,
+        repo_language_col: str = "repo_language",
+        user_languages_col: str = "user_recent_repo_languages",
+        not_found_offset: int = 50,
+    ):
+        self.repo_language_col = repo_language_col
+        self.user_languages_col = user_languages_col
+        # Miss value = len(list) + 50, as repoLanguageIndexInUserRecentRepoLanguagesUDF.
+        self.not_found_offset = not_found_offset
+
+    def transform(self, df: pd.DataFrame) -> pd.DataFrame:
+        self.require_cols(df, [self.repo_language_col, self.user_languages_col])
+        index_out = np.empty(len(df), dtype=np.int32)
+        count_out = np.empty(len(df), dtype=np.int32)
+        for r, (lang, recent) in enumerate(
+            zip(df[self.repo_language_col], df[self.user_languages_col])
+        ):
+            lang = (lang or "").lower()
+            recent = list(recent) if recent is not None else []
+            try:
+                index_out[r] = recent.index(lang)
+            except ValueError:
+                index_out[r] = len(recent) + self.not_found_offset
+            count_out[r] = sum(1 for x in recent if x == lang)
+        out = df.copy()
+        out["repo_language_index_in_user_recent_repo_languages"] = index_out
+        out["repo_language_count_in_user_recent_repo_languages"] = count_out
+        return out

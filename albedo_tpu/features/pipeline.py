@@ -1,0 +1,117 @@
+"""Estimator/Transformer protocol and Pipeline composition.
+
+Reference parity: Spark ML's ``Estimator.fit -> Model`` / ``Transformer.transform``
+contract that every albedo stage implements (``recommenders/Recommender.scala:9``
+extends ``Transformer``; pipelines assembled at
+``LogisticRegressionRanker.scala:227-235``), plus the generic UDF wrapper
+``org/apache/spark/ml/feature/FuncTransformer.scala:45-140``.
+
+Tables are pandas DataFrames on the host; fitted state is numpy/python and
+picklable, persisted through the artifact store (``save_model`` /
+``load_or_create_model`` = ``ModelUtils.loadOrCreateModel``,
+``utils/ModelUtils.scala:7-21``).
+"""
+
+from __future__ import annotations
+
+import pickle
+from pathlib import Path
+from typing import Any, Callable, Sequence, TypeVar
+
+import pandas as pd
+
+T = TypeVar("T")
+
+
+class Transformer:
+    """A fitted, stateless-or-fitted-state stage: ``transform(df) -> df``."""
+
+    def transform(self, df: pd.DataFrame) -> pd.DataFrame:
+        raise NotImplementedError
+
+    def __call__(self, df: pd.DataFrame) -> pd.DataFrame:
+        return self.transform(df)
+
+    def require_cols(self, df: pd.DataFrame, cols: Sequence[str]) -> None:
+        """Runtime schema assertion (the reference's ``transformSchema``
+        ``require`` checks, e.g. ``Recommender.scala:46-56``)."""
+        missing = [c for c in cols if c not in df.columns]
+        if missing:
+            raise ValueError(f"{type(self).__name__}: missing input columns {missing}")
+
+
+class Estimator:
+    """An unfitted stage: ``fit(df) -> Transformer``."""
+
+    def fit(self, df: pd.DataFrame) -> Transformer:
+        raise NotImplementedError
+
+
+class FuncTransformer(Transformer):
+    """Wrap a per-value function as a column transformer
+    (``FuncTransformer.scala:45-140``)."""
+
+    def __init__(self, func: Callable[[Any], Any], input_col: str, output_col: str):
+        self.func = func
+        self.input_col = input_col
+        self.output_col = output_col
+
+    def transform(self, df: pd.DataFrame) -> pd.DataFrame:
+        self.require_cols(df, [self.input_col])
+        out = df.copy()
+        out[self.output_col] = [self.func(v) for v in df[self.input_col]]
+        return out
+
+
+class PipelineModel(Transformer):
+    """A fitted pipeline: transformers applied in sequence."""
+
+    def __init__(self, stages: list[Transformer]):
+        self.stages = stages
+
+    def transform(self, df: pd.DataFrame) -> pd.DataFrame:
+        for stage in self.stages:
+            df = stage.transform(df)
+        return df
+
+    def __getitem__(self, i: int) -> Transformer:
+        return self.stages[i]
+
+
+class Pipeline(Estimator):
+    """Fit stages in order, each transforming the frame the next one sees —
+    Spark ``Pipeline.fit`` semantics."""
+
+    def __init__(self, stages: Sequence[Estimator | Transformer]):
+        self.stages = list(stages)
+
+    def fit(self, df: pd.DataFrame) -> PipelineModel:
+        fitted: list[Transformer] = []
+        for stage in self.stages:
+            if isinstance(stage, Estimator):
+                model = stage.fit(df)
+            elif isinstance(stage, Transformer):
+                model = stage
+            else:
+                raise TypeError(f"pipeline stage {stage!r} is neither Estimator nor Transformer")
+            df = model.transform(df)
+            fitted.append(model)
+        return PipelineModel(fitted)
+
+
+def save_model(path: Path, model: Any) -> None:
+    with open(path, "wb") as f:
+        pickle.dump(model, f)
+
+
+def load_model(path: Path) -> Any:
+    with open(path, "rb") as f:
+        return pickle.load(f)
+
+
+def load_or_create_model(name: str, create: Callable[[], T]) -> T:
+    """``ModelUtils.loadOrCreateModel`` parity: load the artifact if
+    materialized today, else train and save (``utils/ModelUtils.scala:7-21``)."""
+    from albedo_tpu.datasets.artifacts import load_or_create
+
+    return load_or_create(name, create, save_model, load_model)

@@ -32,7 +32,7 @@ _RE_FORMERLY = re.compile(r"\b(formerly|previously)\b|\bex-")
 _RE_NON_WORD = re.compile(r"[^\w぀-ゟ゠-ヿ㄀-ㄯ豈-﫿一-鿿]+")
 _RE_CORP_WORDS = re.compile(r"\b(http|https|www|co ltd|pvt ltd|ltd|inc|llc)\b")
 _RE_SPACES = re.compile(r"\s+")
-_RE_CITY_PAIR = re.compile(f"([{_WORD_CJK}]+),\\s*([{_WORD_CJK}]+)")
+_RE_CITY_PAIR = re.compile(f"([{_WORD_CJK} ]+?)\\s*,\\s*([{_WORD_CJK} ]+)")
 _RE_LOC_PUNCT = re.compile(r"""[~!@#$^%&*()_+={}\[\]|;:"'<,>.?`/\\-]+""")
 _RE_CITY_WORD = re.compile(r"\b(city)\b")
 
@@ -74,7 +74,7 @@ def clean_location(location: str) -> str:
     "City, Country" keeps the city, then lowercases, strips punctuation and a
     literal "city" word; ``__empty`` fallback."""
     m = _RE_CITY_PAIR.match(location)
-    t = m.group(1) if m else location
+    t = m.group(1) if m else location  # "San Francisco, CA" -> "San Francisco"
     t = t.lower()
     t = _RE_LOC_PUNCT.sub(" ", t)
     t = _RE_SPACES.sub(" ", t)

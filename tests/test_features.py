@@ -1,0 +1,253 @@
+"""Feature/transformer layer (L2) unit tests.
+
+Parity anchors: ``transformers/*.scala``, ``org/apache/spark/ml/feature/*.scala``,
+and the weight SQL at ``LogisticRegressionRanker.scala:316-328``.
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from albedo_tpu.features import (
+    CountVectorizer,
+    FeatureAssembler,
+    FrequencyBinner,
+    FuncTransformer,
+    InstanceWeigher,
+    NegativeBalancer,
+    Pipeline,
+    SnowballStemmer,
+    StopWordsRemover,
+    StringIndexer,
+    Tokenizer,
+    UserRepoTransformer,
+)
+from albedo_tpu.features.balancer import SENTINEL_TIME
+from albedo_tpu.features.text import porter_stem
+
+
+# --- StringIndexer -----------------------------------------------------------
+
+
+def test_string_indexer_frequency_order():
+    df = pd.DataFrame({"x": ["b", "a", "b", "c", "b", "a"]})
+    model = StringIndexer("x").fit(df)
+    assert model.labels == ["b", "a", "c"]  # freq desc, ties by value
+    out = model.transform(df)
+    assert out["x__idx"].tolist() == [0, 1, 0, 2, 0, 1]
+
+
+def test_string_indexer_handle_invalid_keep():
+    model = StringIndexer("x").fit(pd.DataFrame({"x": ["a", "b"]}))
+    out = model.transform(pd.DataFrame({"x": ["a", "zzz"]}))
+    assert out["x__idx"].tolist() == [0, 2]  # unknown -> len(labels)
+    assert model.vocab_size == 3  # includes the unknown slot
+
+
+def test_string_indexer_handle_invalid_error():
+    model = StringIndexer("x", handle_invalid="error").fit(pd.DataFrame({"x": ["a"]}))
+    with pytest.raises(ValueError, match="unseen label"):
+        model.transform(pd.DataFrame({"x": ["nope"]}))
+
+
+def test_frequency_binner():
+    df = pd.DataFrame({"c": ["goog", "goog", "goog", "rare", "tiny"]})
+    out = FrequencyBinner("c", "c_binned", threshold=2).fit(df).transform(df)
+    assert out["c_binned"].tolist() == ["goog", "goog", "goog", "__other", "__other"]
+
+
+# --- Tokenizer / stop words / count vectorizer -------------------------------
+
+
+def test_tokenizer_language_tokens_kept():
+    t = Tokenizer("txt", remove_stop_words=False)
+    toks = t.tokenize("I like C++ and c# and F# and R and c")
+    assert "c++" in toks and "c#" in toks and "f#" in toks
+    assert "r" in toks and "c" in toks  # single-letter languages survive
+    assert "i" not in toks  # other 1-char non-CJK dropped
+
+
+def test_tokenizer_cjk_unigrams_and_stopwords():
+    t = Tokenizer("txt", remove_stop_words=True)
+    toks = t.tokenize("the quick 機械学習 toolkit")
+    assert "the" not in toks
+    assert "quick" in toks and "toolkit" in toks
+    for ch in "機械学習":
+        assert ch in toks
+
+
+def test_tokenizer_transform_column():
+    df = pd.DataFrame({"txt": ["fast web framework", ""]})
+    out = Tokenizer("txt").transform(df)
+    assert out["txt__words"].tolist()[0] == ["fast", "web", "framework"]
+    assert out["txt__words"].tolist()[1] == []
+
+
+def test_stop_words_remover():
+    df = pd.DataFrame({"w": [["the", "fast", "of", "engine"]]})
+    out = StopWordsRemover("w").transform(df)
+    assert out["w__filtered"].tolist()[0] == ["fast", "engine"]
+
+
+def test_count_vectorizer_min_df_and_counts():
+    docs = [["a", "b"], ["a", "c"], ["a", "b", "b"]]
+    df = pd.DataFrame({"w": docs})
+    model = CountVectorizer("w", min_df=2).fit(df)
+    assert model.vocab == ["a", "b"]  # c has df=1 < 2; a(3) before b(2)
+    out = model.transform(df)
+    idx, val = out["w__cv__bag_idx"][2], out["w__cv__bag_val"][2]
+    got = dict(zip(idx.tolist(), val.tolist()))
+    assert got == {0: 1.0, 1: 2.0}
+
+
+def test_porter_stemmer():
+    assert porter_stem("caresses") == "caress"
+    assert porter_stem("ponies") == "poni"
+    assert porter_stem("running") == "run"
+    assert porter_stem("relational") == "relat"
+    df = pd.DataFrame({"w": [["libraries", "frameworks"]]})
+    out = SnowballStemmer("w").transform(df)
+    assert out["w__stemmed"].tolist()[0] == [porter_stem("libraries"), porter_stem("frameworks")]
+
+
+# --- cross features / weights / balancer -------------------------------------
+
+
+def test_user_repo_transformer():
+    df = pd.DataFrame(
+        {
+            "repo_language": ["Python", "Go", ""],
+            "user_recent_repo_languages": [
+                ["python", "go", "python"],
+                ["python", "rust"],
+                ["python"],
+            ],
+        }
+    )
+    out = UserRepoTransformer().transform(df)
+    assert out["repo_language_index_in_user_recent_repo_languages"].tolist() == [0, 2 + 50, 1 + 50]
+    assert out["repo_language_count_in_user_recent_repo_languages"].tolist() == [2, 0, 0]
+
+
+def test_instance_weigher_variants():
+    now = 1.6e9
+    df = pd.DataFrame(
+        {
+            "starring": [1.0, 1.0, 0.0],
+            "starred_at": [now - 100 * 86400, now - 400 * 86400, SENTINEL_TIME],
+            "repo_created_at": [now - 700 * 86400, now - 800 * 86400, now - 10 * 86400],
+        }
+    )
+    out = InstanceWeigher(now=now).transform(df)
+    assert out["default_weight"].tolist() == [1.0, 1.0, 1.0]
+    assert out["positive_weight"].tolist() == [0.9, 0.9, 0.1]
+    assert out["positive_starred_weight"].tolist() == [0.9, 0.1, 0.1]
+    assert out["positive_created_weight"].tolist() == [0.9, 0.1, 0.1]
+    # week number for positives, 1.0 for negatives
+    assert out["positive_created_week_weight"].tolist()[2] == 1.0
+    assert out["positive_created_week_weight"].tolist()[0] == round((now - 700 * 86400) / (7 * 86400))
+
+
+def test_negative_balancer_popular_minus_positives():
+    popular = np.array([100, 101, 102, 103, 104])
+    df = pd.DataFrame(
+        {
+            "user_id": [1, 1, 2],
+            "repo_id": [100, 102, 900],
+            "starred_at": [5.0, 6.0, 7.0],
+            "starring": [1.0, 1.0, 1.0],
+        }
+    )
+    out = NegativeBalancer(popular, negative_positive_ratio=1.0).transform(df)
+    u1 = out[(out["user_id"] == 1) & (out["starring"] == 0.0)]
+    # user 1 starred 100,102 -> top-2 unstarred popular = 101, 103
+    assert u1["repo_id"].tolist() == [101, 103]
+    assert (u1["starred_at"] == SENTINEL_TIME).all()
+    u2 = out[(out["user_id"] == 2) & (out["starring"] == 0.0)]
+    assert u2["repo_id"].tolist() == [100]  # 1 positive -> 1 negative, most popular
+    # positives preserved
+    assert len(out[out["starring"] == 1.0]) == 3
+
+
+def test_negative_balancer_ratio():
+    popular = np.arange(1000, 1050)
+    df = pd.DataFrame(
+        {
+            "user_id": [7] * 4,
+            "repo_id": [1000, 1001, 1002, 1003],
+            "starred_at": np.arange(4.0),
+            "starring": np.ones(4),
+        }
+    )
+    out = NegativeBalancer(popular, negative_positive_ratio=2.0).transform(df)
+    assert (out["starring"] == 0.0).sum() == 8
+
+
+# --- assembler ---------------------------------------------------------------
+
+
+def test_feature_assembler_blocks_and_dense_equivalence():
+    df = pd.DataFrame(
+        {
+            "num": [1.0, 2.0, 3.0],
+            "flag": [True, False, True],
+            "cat": ["x", "y", "x"],
+            "words": [["a", "b"], ["b"], []],
+            "vec": [np.ones(2, np.float32) * i for i in range(3)],
+        }
+    )
+    pipe = Pipeline([
+        StringIndexer("cat"),
+        CountVectorizer("words", min_df=1),
+    ])
+    model = pipe.fit(df)
+    feat_df = model.transform(df)
+    asm = FeatureAssembler(
+        dense_cols=["num", "flag"],
+        vector_cols=["vec"],
+        cat_cols={"cat__idx": None},
+        bag_cols={"words__cv": None},
+    ).fit(feat_df)
+    fm = asm.assemble(feat_df)
+
+    assert fm.dense.shape == (3, 4)  # num, flag, vec[0], vec[1]
+    assert fm.cat["cat__idx"].tolist() == [0, 1, 0]
+    assert fm.cat_sizes["cat__idx"] == 3  # x, y, unknown slot
+    assert fm.bag_sizes["words__cv"] == 2
+    assert fm.num_features == 4 + 3 + 2
+
+    dense = fm.to_dense()
+    assert dense.shape == (3, fm.num_features)
+    # row 0: num=1, flag=1, vec=[0,0], onehot x=[1,0,0], bag a+b=[1,1]
+    np.testing.assert_allclose(dense[0], [1, 1, 0, 0, 1, 0, 0, 1, 1])
+    # row 2: empty bag -> zeros
+    np.testing.assert_allclose(dense[2, -2:], [0, 0])
+
+
+def test_assembler_select_rows():
+    df = pd.DataFrame({"n": [1.0, 2.0, 3.0]})
+    fm = FeatureAssembler(dense_cols=["n"]).fit(df).assemble(df)
+    sub = fm.select(np.array([2, 0]))
+    assert sub.dense[:, 0].tolist() == [3.0, 1.0]
+
+
+# --- pipeline protocol -------------------------------------------------------
+
+
+def test_pipeline_fit_transform_chains():
+    df = pd.DataFrame({"t": ["Fast Web", "Tiny Engine"]})
+    pipe = Pipeline([
+        FuncTransformer(str.lower, "t", "t_low"),
+        Tokenizer("t_low", remove_stop_words=False),
+        StringIndexer("t"),
+    ])
+    model = pipe.fit(df)
+    out = model.transform(df)
+    assert out["t_low__words"].tolist() == [["fast", "web"], ["tiny", "engine"]]
+    assert "t__idx" in out.columns
+    assert len(model.stages) == 3
+
+
+def test_transformer_schema_assertion():
+    with pytest.raises(ValueError, match="missing input columns"):
+        Tokenizer("nope").transform(pd.DataFrame({"x": [1]}))
