@@ -93,6 +93,25 @@ def load_or_create_npz(name: str, create: Callable[[], dict[str, np.ndarray]]):
     return load_or_create(name, create, _save, _load)
 
 
+def save_pickle(path: Path, value: Any) -> None:
+    import pickle
+
+    with open(path, "wb") as f:
+        pickle.dump(value, f)
+
+
+def load_pickle(path: Path) -> Any:
+    import pickle
+
+    with open(path, "rb") as f:
+        return pickle.load(f)
+
+
+def load_or_create_pickle(name: str, create: Callable[[], T]) -> T:
+    """Memoize an arbitrary picklable value (fitted models, table sets)."""
+    return load_or_create(name, create, save_pickle, load_pickle)
+
+
 def load_or_create_json(name: str, create: Callable[[], Any]):
     def _save(path: Path, value: Any) -> None:
         path.write_text(json.dumps(value, indent=2, sort_keys=True))

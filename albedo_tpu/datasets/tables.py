@@ -133,7 +133,7 @@ _FALSY_STRINGS = {"", "0", "false", "f", "no", "n", "none", "null", "nan"}
 def _to_bool(v) -> bool:
     if isinstance(v, str):
         return v.strip().lower() not in _FALSY_STRINGS
-    if v is None or (isinstance(v, float) and np.isnan(v)):
+    if v is None or v is pd.NA or (isinstance(v, float) and np.isnan(v)):
         return False
     return bool(v)
 
@@ -156,9 +156,9 @@ def conform(df: pd.DataFrame, schema: dict[str, str], renames: dict[str, str] | 
         if dtype == "string":
             s = s.astype("string").fillna("")
         elif dtype == "bool":
-            # CSV/sqlite ingest may carry booleans as strings or 0/1 ints;
-            # a bare astype(bool) would turn "false"/"0" into True.
-            s = s.map(_to_bool).fillna(False).astype(bool)
+            # CSV/sqlite ingest may carry booleans as strings, 0/1 ints, or
+            # nullable dtypes; a bare astype(bool) would turn "false" into True.
+            s = pd.Series([_to_bool(v) for v in s], dtype=bool)
         else:
             s = pd.to_numeric(s, errors="coerce").fillna(0).astype(dtype)
         out[col] = s.reset_index(drop=True)
@@ -270,21 +270,11 @@ def load_or_create_raw_tables(create: Callable[[], RawTables]) -> RawTables:
     caching idiom, ``utils/DatasetUtils.scala:52-133``). All four tables live in
     ONE artifact so a killed job can never resume with a torn set (user_info
     from one ``create()`` invocation, starring from another)."""
-    import pickle
-
-    from albedo_tpu.datasets.artifacts import load_or_create
+    from albedo_tpu.datasets.artifacts import load_or_create_pickle
 
     def _create() -> dict[str, pd.DataFrame]:
         t = create().conformed()
         return {key: getattr(t, key) for key in _TABLE_FILES}
 
-    def _save(path, frames: dict[str, pd.DataFrame]) -> None:
-        with open(path, "wb") as f:
-            pickle.dump(frames, f)
-
-    def _load(path) -> dict[str, pd.DataFrame]:
-        with open(path, "rb") as f:
-            return pickle.load(f)
-
-    frames = load_or_create("raw_tables.pkl", _create, _save, _load)
+    frames = load_or_create_pickle("raw_tables.pkl", _create)
     return RawTables(**frames)

@@ -54,6 +54,8 @@ class NegativeBalancer(Transformer):
         order = np.argsort(users, kind="stable")
         bounds = np.nonzero(np.diff(users[order]))[0] + 1
         for chunk in np.split(order, bounds):
+            if chunk.size == 0:  # empty input frame
+                continue
             u = users[chunk[0]]
             positives = set(items[chunk].tolist())
             need = int(len(positives) * self.negative_positive_ratio)

@@ -7,15 +7,12 @@ extends ``Transformer``; pipelines assembled at
 ``org/apache/spark/ml/feature/FuncTransformer.scala:45-140``.
 
 Tables are pandas DataFrames on the host; fitted state is numpy/python and
-picklable, persisted through the artifact store (``save_model`` /
-``load_or_create_model`` = ``ModelUtils.loadOrCreateModel``,
-``utils/ModelUtils.scala:7-21``).
+picklable, persisted through the artifact store (``load_or_create_model`` =
+``ModelUtils.loadOrCreateModel``, ``utils/ModelUtils.scala:7-21``).
 """
 
 from __future__ import annotations
 
-import pickle
-from pathlib import Path
 from typing import Any, Callable, Sequence, TypeVar
 
 import pandas as pd
@@ -99,19 +96,9 @@ class Pipeline(Estimator):
         return PipelineModel(fitted)
 
 
-def save_model(path: Path, model: Any) -> None:
-    with open(path, "wb") as f:
-        pickle.dump(model, f)
-
-
-def load_model(path: Path) -> Any:
-    with open(path, "rb") as f:
-        return pickle.load(f)
-
-
 def load_or_create_model(name: str, create: Callable[[], T]) -> T:
     """``ModelUtils.loadOrCreateModel`` parity: load the artifact if
     materialized today, else train and save (``utils/ModelUtils.scala:7-21``)."""
-    from albedo_tpu.datasets.artifacts import load_or_create
+    from albedo_tpu.datasets.artifacts import load_or_create_pickle
 
-    return load_or_create(name, create, save_model, load_model)
+    return load_or_create_pickle(name, create)

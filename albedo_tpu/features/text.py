@@ -181,11 +181,15 @@ class CountVectorizer(Estimator):
         self.binary = binary
 
     def fit(self, df: pd.DataFrame) -> CountVectorizerModel:
+        # min_df filters on DOCUMENT frequency; vocab order/truncation use
+        # total TERM frequency — Spark CountVectorizer semantics.
         doc_freq: Counter = Counter()
+        term_freq: Counter = Counter()
         for words in df[self.input_col]:
             doc_freq.update(set(words))
+            term_freq.update(words)
         terms = [
-            (w, c) for w, c in doc_freq.items() if c >= self.min_df
+            (w, term_freq[w]) for w, c in doc_freq.items() if c >= self.min_df
         ]
         terms.sort(key=lambda wc: (-wc[1], wc[0]))
         vocab = [w for w, _ in terms[: self.max_vocab]]

@@ -1,0 +1,207 @@
+"""Word2Vec: skip-gram embeddings trained on device.
+
+Reference parity: ``Word2VecCorpusBuilder.scala:74-83`` — Spark MLlib
+``Word2Vec`` with vectorSize=200, windowSize=5, minCount=10, maxIter=30 over
+the user+repo text corpus, and ``Word2VecModel.transform`` averaging word
+vectors per document as the text-column featurizer
+(``LogisticRegressionRanker.scala:210-215``).
+
+TPU-first design: MLlib trains hierarchical-softmax skip-gram with per-worker
+Hogwild updates and averages the tables; here it's skip-gram with NEGATIVE
+SAMPLING — a fixed-shape batched objective (gathers + one (B, k+1) logits
+einsum) that XLA fuses onto the MXU, instead of data-dependent Huffman-tree
+walks that would defeat jit. Pairs are built once on host; the training loop
+is a ``lax.scan`` over minibatches with negatives drawn per step on device.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import Counter
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pandas as pd
+
+from albedo_tpu.features.pipeline import Transformer
+
+
+@dataclasses.dataclass
+class Word2VecModel(Transformer):
+    """Fitted embeddings + the document-averaging transformer."""
+
+    vocab: list[str]
+    vectors: np.ndarray  # (V, dim) float32
+    input_col: str = "words"
+    output_col: str = "words__w2v"
+
+    def __post_init__(self):
+        self._index = {w: i for i, w in enumerate(self.vocab)}
+
+    @property
+    def dim(self) -> int:
+        return int(self.vectors.shape[1])
+
+    def vector(self, word: str) -> np.ndarray | None:
+        i = self._index.get(word)
+        return None if i is None else self.vectors[i]
+
+    def document_vector(self, words: list[str]) -> np.ndarray:
+        """Mean of in-vocab word vectors (zero vector if none)."""
+        idx = [self._index[w] for w in words if w in self._index]
+        if not idx:
+            return np.zeros(self.dim, dtype=np.float32)
+        return self.vectors[idx].mean(axis=0)
+
+    def transform(self, df: pd.DataFrame) -> pd.DataFrame:
+        self.require_cols(df, [self.input_col])
+        out = df.copy()
+        out[self.output_col] = [self.document_vector(ws) for ws in df[self.input_col]]
+        return out
+
+    def find_synonyms(self, word: str, k: int = 10) -> list[tuple[str, float]]:
+        """Cosine-similarity nearest words (Spark ``findSynonyms`` parity)."""
+        v = self.vector(word)
+        if v is None:
+            return []
+        norms = np.linalg.norm(self.vectors, axis=1) + 1e-9
+        sims = self.vectors @ v / (norms * (np.linalg.norm(v) + 1e-9))
+        order = np.argsort(-sims)
+        return [
+            (self.vocab[i], float(sims[i])) for i in order if self.vocab[i] != word
+        ][:k]
+
+    def to_arrays(self) -> dict[str, np.ndarray]:
+        return {
+            "vectors": self.vectors,
+            "vocab": np.asarray(self.vocab, dtype=object),
+        }
+
+
+@dataclasses.dataclass
+class Word2Vec:
+    """Skip-gram negative-sampling estimator.
+
+    Defaults mirror the reference corpus builder
+    (``Word2VecCorpusBuilder.scala:74-83``): dim=200, window=5, min_count=10,
+    max_iter=30 (epochs over the pair set).
+    """
+
+    dim: int = 200
+    window: int = 5
+    min_count: int = 10
+    max_iter: int = 30
+    negatives: int = 5
+    batch_size: int = 4096
+    learning_rate: float = 0.025
+    subsample: float = 1e-3  # frequent-word subsampling threshold (0 = off)
+    seed: int = 42
+    input_col: str = "words"
+    output_col: str | None = None
+
+    def fit_corpus(self, sentences: list[list[str]]) -> Word2VecModel:
+        rng = np.random.default_rng(self.seed)
+        counts = Counter(w for s in sentences for w in s)
+        vocab = [w for w, c in sorted(counts.items(), key=lambda kv: (-kv[1], kv[0])) if c >= self.min_count]
+        index = {w: i for i, w in enumerate(vocab)}
+        v_size = len(vocab)
+        if v_size == 0:
+            return Word2VecModel([], np.zeros((0, self.dim), np.float32), self.input_col, self.output_col or f"{self.input_col}__w2v")
+
+        freq = np.array([counts[w] for w in vocab], dtype=np.float64)
+        total = freq.sum()
+
+        # Frequent-word subsampling (word2vec's t-threshold keep probability).
+        if self.subsample > 0:
+            f = freq / total
+            keep_p = np.minimum(1.0, np.sqrt(self.subsample / f) + self.subsample / f)
+        else:
+            keep_p = np.ones(v_size)
+
+        centers, contexts = [], []
+        for s in sentences:
+            ids = np.array([index[w] for w in s if w in index], dtype=np.int32)
+            if self.subsample > 0 and ids.size:
+                ids = ids[rng.random(ids.size) < keep_p[ids]]
+            n = ids.size
+            if n < 2:
+                continue
+            # Dynamic window shrink, as word2vec: b ~ uniform[1, window].
+            b = rng.integers(1, self.window + 1, size=n)
+            for i in range(n):
+                lo, hi = max(0, i - b[i]), min(n, i + b[i] + 1)
+                for j in range(lo, hi):
+                    if j != i:
+                        centers.append(ids[i])
+                        contexts.append(ids[j])
+        if not centers:
+            return Word2VecModel(vocab, np.zeros((v_size, self.dim), np.float32), self.input_col, self.output_col or f"{self.input_col}__w2v")
+
+        centers = np.asarray(centers, dtype=np.int32)
+        contexts = np.asarray(contexts, dtype=np.int32)
+
+        # Negative-sampling distribution: unigram^0.75 (word2vec standard).
+        noise_logits = jnp.asarray(0.75 * np.log(freq), dtype=jnp.float32)
+
+        n_pairs = centers.shape[0]
+        bs = min(self.batch_size, n_pairs)
+        steps_per_epoch = n_pairs // bs
+
+        key = jax.random.PRNGKey(self.seed)
+        k_in, k_shuf = jax.random.split(key)
+        scale = 0.5 / self.dim
+        params = {
+            "in": jax.random.uniform(k_in, (v_size, self.dim), jnp.float32, -scale, scale),
+            "out": jnp.zeros((v_size, self.dim), jnp.float32),
+        }
+        opt = optax.adam(self.learning_rate)
+        opt_state = opt.init(params)
+
+        neg = self.negatives
+
+        def loss_fn(p, c_idx, o_idx, neg_idx):
+            # (B, d) center vectors; (B, 1+neg, d) context rows (true + noise).
+            vc = p["in"][c_idx]
+            rows = jnp.concatenate([o_idx[:, None], neg_idx], axis=1)
+            vo = p["out"][rows]
+            logits = jnp.einsum("bd,bkd->bk", vc, vo)
+            labels = jnp.zeros_like(logits).at[:, 0].set(1.0)
+            return optax.sigmoid_binary_cross_entropy(logits, labels).sum(axis=1).mean()
+
+        @jax.jit
+        def epoch(params, opt_state, key, centers_d, contexts_d):
+            key, k_perm = jax.random.split(key)
+            perm = jax.random.permutation(k_perm, centers_d.shape[0])
+            c_sh = centers_d[perm][: steps_per_epoch * bs].reshape(steps_per_epoch, bs)
+            o_sh = contexts_d[perm][: steps_per_epoch * bs].reshape(steps_per_epoch, bs)
+
+            def step(carry, batch):
+                p, s, k = carry
+                c_idx, o_idx = batch
+                k, k_neg = jax.random.split(k)
+                neg_idx = jax.random.categorical(k_neg, noise_logits, shape=(bs, neg))
+                loss, grads = jax.value_and_grad(loss_fn)(p, c_idx, o_idx, neg_idx)
+                updates, s = opt.update(grads, s, p)
+                return (optax.apply_updates(p, updates), s, k), loss
+
+            (params, opt_state, key), losses = jax.lax.scan(
+                step, (params, opt_state, key), (c_sh, o_sh)
+            )
+            return params, opt_state, key, losses.mean()
+
+        centers_d = jnp.asarray(centers)
+        contexts_d = jnp.asarray(contexts)
+        for _ in range(self.max_iter):
+            params, opt_state, key, _loss = epoch(params, opt_state, key, centers_d, contexts_d)
+
+        return Word2VecModel(
+            vocab,
+            np.asarray(params["in"], dtype=np.float32),
+            self.input_col,
+            self.output_col or f"{self.input_col}__w2v",
+        )
+
+    def fit(self, df: pd.DataFrame) -> Word2VecModel:
+        return self.fit_corpus(list(df[self.input_col]))

@@ -1,0 +1,140 @@
+"""Block-sparse linear model kernels over a ``FeatureMatrix``.
+
+The reference's ranker trains Spark MLlib ``LogisticRegression`` on a giant
+sparse vector assembled from one-hots over every categorical (including
+``user_id``/``repo_id``) plus count-vectors and word2vec blocks
+(``LogisticRegressionRanker.scala:176-235``). The TPU-native layout keeps the
+blocks separate (``features/assembler.py``): the linear form
+
+``logit = b + dense @ w_dense + sum_f W_cat[f][idx_f] + sum_f <bag_val, W_bag[f][bag_idx]>``
+
+is mathematically the one-hot dot product, computed as weight-row gathers and
+masked reductions — fixed shapes, no million-wide vectors.
+
+Standardization (Spark ``setStandardization(true)``): features are implicitly
+scaled by ``1/std`` (no centering, preserving sparsity, as MLlib). Training
+optimizes the coefficients of the SCALED features with the L2 penalty applied
+to them (MLlib's convention), which is what makes regParam=0.7 reproduce the
+reference's AUC; ``fold_scales`` converts back to raw-space coefficients.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from albedo_tpu.features.assembler import FeatureMatrix
+
+Params = dict[str, Any]
+
+
+def feature_batch(fm: FeatureMatrix) -> dict[str, jnp.ndarray]:
+    """Upload a FeatureMatrix's arrays as a flat dict of device arrays."""
+    batch: dict[str, jnp.ndarray] = {"dense": jnp.asarray(fm.dense)}
+    for f, v in fm.cat.items():
+        batch[f"cat:{f}"] = jnp.asarray(v)
+    for f in fm.bag_idx:
+        batch[f"bag_idx:{f}"] = jnp.asarray(fm.bag_idx[f])
+        batch[f"bag_val:{f}"] = jnp.asarray(fm.bag_val[f])
+    return batch
+
+
+def init_params(fm: FeatureMatrix) -> Params:
+    p: Params = {
+        "bias": jnp.zeros((), jnp.float32),
+        "dense": jnp.zeros((fm.dense.shape[1],), jnp.float32),
+    }
+    for f, size in fm.cat_sizes.items():
+        p[f"cat:{f}"] = jnp.zeros((size,), jnp.float32)
+    for f, size in fm.bag_sizes.items():
+        p[f"bag:{f}"] = jnp.zeros((size,), jnp.float32)
+    return p
+
+
+def inverse_std_scales(fm: FeatureMatrix) -> Params:
+    """Per-feature ``1/std`` in the same structure as the params (host side).
+
+    One-hot/bag columns get the std of their expanded 0/1(or count) column;
+    constant features get scale 0 so their (useless) coefficient is frozen at
+    zero effect, mirroring MLlib's handling of zero-variance features.
+    """
+    n = max(1, fm.n_rows)
+
+    def inv(std: np.ndarray) -> np.ndarray:
+        return np.where(std > 0, 1.0 / np.maximum(std, 1e-12), 0.0).astype(np.float32)
+
+    scales: Params = {"bias": np.float32(1.0)}
+    d = fm.dense.astype(np.float64)
+    std = d.std(axis=0)
+    scales["dense"] = inv(std)
+    for f, size in fm.cat_sizes.items():
+        p = np.bincount(fm.cat[f], minlength=size) / n
+        scales[f"cat:{f}"] = inv(np.sqrt(p * (1 - p)))
+    for f, size in fm.bag_sizes.items():
+        idx, val = fm.bag_idx[f], fm.bag_val[f]
+        ok = idx >= 0
+        rows = np.broadcast_to(np.arange(fm.n_rows)[:, None], idx.shape)[ok]
+        cols = idx[ok].astype(np.int64)
+        vals = val[ok].astype(np.float64)
+        # Aggregate duplicate indices within a row first: the expanded column
+        # value is the SUM of a row's entries for that index, so moments must
+        # be taken over per-(row, col) sums.
+        key = rows.astype(np.int64) * size + cols
+        order = np.argsort(key, kind="stable")
+        key_s, vals_s = key[order], vals[order]
+        uniq, start = np.unique(key_s, return_index=True)
+        agg = np.add.reduceat(vals_s, start) if start.size else np.zeros(0)
+        col_of = uniq % size
+        s1 = np.bincount(col_of, weights=agg, minlength=size)
+        s2 = np.bincount(col_of, weights=agg**2, minlength=size)
+        mean = s1 / n
+        var = s2 / n - mean**2
+        scales[f"bag:{f}"] = inv(np.sqrt(np.maximum(var, 0)))
+    return scales
+
+
+def block_logits(params: Params, scales: Params, batch: dict[str, jnp.ndarray]) -> jnp.ndarray:
+    """(N,) logits; ``params`` are standardized-space coefficients and
+    ``scales`` the per-feature 1/std factors (use all-ones for raw space)."""
+    logits = params["bias"] + (batch["dense"] * scales["dense"]) @ params["dense"]
+    for key, arr in batch.items():
+        if key.startswith("cat:"):
+            f = key[len("cat:"):]
+            w = params[f"cat:{f}"] * scales[f"cat:{f}"]
+            logits = logits + w[arr]
+        elif key.startswith("bag_idx:"):
+            f = key[len("bag_idx:"):]
+            w = params[f"bag:{f}"] * scales[f"bag:{f}"]
+            idx = arr
+            val = batch[f"bag_val:{f}"]
+            safe = jnp.where(idx < 0, 0, idx)
+            contrib = jnp.where(idx < 0, 0.0, w[safe] * val)
+            logits = logits + contrib.sum(axis=1)
+    return logits
+
+
+def weighted_logloss(
+    params: Params,
+    scales: Params,
+    batch: dict[str, jnp.ndarray],
+    labels: jnp.ndarray,
+    weights: jnp.ndarray,
+    reg: float,
+) -> jnp.ndarray:
+    """MLlib objective: (sum_i w_i * ce_i) / sum_i w_i + 0.5 * reg * ||beta_std||^2
+    (bias unpenalized)."""
+    logits = block_logits(params, scales, batch)
+    ce = jnp.maximum(logits, 0) - logits * labels + jnp.log1p(jnp.exp(-jnp.abs(logits)))
+    data = jnp.sum(weights * ce) / jnp.sum(weights)
+    pen = sum(
+        jnp.sum(v**2) for k, v in params.items() if k != "bias"
+    )
+    return data + 0.5 * reg * pen
+
+
+def fold_scales(params: Params, scales: Params) -> Params:
+    """Convert standardized-space coefficients to raw-space (beta = beta_std / std)."""
+    return jax.tree.map(lambda p, s: p * s, params, scales)

@@ -1,0 +1,220 @@
+"""JAX model layer: Word2Vec skip-gram and weighted logistic regression.
+
+Parity anchors: ``Word2VecCorpusBuilder.scala:74-83`` (w2v config + transform
+averaging) and ``LogisticRegressionRanker.scala:330-337`` (weighted L2 LR,
+standardization).
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from albedo_tpu.evaluators import area_under_roc
+from albedo_tpu.features.assembler import FeatureMatrix
+from albedo_tpu.models.logistic_regression import LogisticRegression
+from albedo_tpu.models.word2vec import Word2Vec
+from albedo_tpu.ops.sparse_linear import (
+    block_logits,
+    feature_batch,
+    fold_scales,
+    init_params,
+    inverse_std_scales,
+)
+
+
+def make_fm(rng, n=500, d=3, cat_v=4, bag_v=6, bag_l=3):
+    dense = rng.normal(size=(n, d)).astype(np.float32)
+    cat = rng.integers(0, cat_v, size=n).astype(np.int32)
+    bag_idx = rng.integers(0, bag_v, size=(n, bag_l)).astype(np.int32)
+    bag_idx[rng.random((n, bag_l)) < 0.4] = -1
+    bag_val = np.where(bag_idx >= 0, rng.integers(1, 3, size=(n, bag_l)), 0).astype(np.float32)
+    return FeatureMatrix(
+        dense=dense,
+        dense_names=[f"d{i}" for i in range(d)],
+        cat={"c": cat},
+        cat_sizes={"c": cat_v},
+        bag_idx={"b": bag_idx},
+        bag_val={"b": bag_val},
+        bag_sizes={"b": bag_v},
+    )
+
+
+# --- sparse-linear ops -------------------------------------------------------
+
+
+def test_block_logits_match_dense_onehot(rng):
+    """The gather/segment-sum form == one-hot dot product (same math as the
+    reference's SimpleVectorAssembler + dense LR, without the wide vectors)."""
+    import jax
+
+    fm = make_fm(rng, n=50)
+    params = init_params(fm)
+    params = jax.tree.map(
+        lambda p: np.asarray(rng.normal(size=p.shape), dtype=np.float32), params
+    )
+    ones = jax.tree.map(lambda p: np.ones_like(p), params)
+    got = np.asarray(block_logits(params, ones, feature_batch(fm)))
+
+    flat = np.concatenate(
+        [params["dense"], params["cat:c"], params["bag:b"]]
+    )
+    want = fm.to_dense() @ flat + params["bias"]
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_inverse_std_scales_match_dense_std(rng):
+    fm = make_fm(rng, n=400)
+    scales = inverse_std_scales(fm)
+    dense_std = fm.to_dense().std(axis=0)
+    flat = np.concatenate([scales["dense"], scales["cat:c"], scales["bag:b"]])
+    expect = np.where(dense_std > 0, 1.0 / np.maximum(dense_std, 1e-12), 0.0)
+    np.testing.assert_allclose(flat, expect, rtol=1e-3, atol=1e-5)
+
+
+# --- logistic regression -----------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def lr_problem():
+    rng = np.random.default_rng(7)
+    fm = make_fm(rng, n=1500)
+    true_w = rng.normal(size=fm.num_features) * 1.5
+    logits = fm.to_dense() @ true_w - 0.2
+    y = (rng.random(fm.n_rows) < 1.0 / (1.0 + np.exp(-logits))).astype(np.float32)
+    return fm, y
+
+
+def test_lr_matches_scipy_optimum(lr_problem):
+    """Full-batch L-BFGS reaches the same objective value as scipy on the
+    equivalent dense problem (exact objective parity)."""
+    from scipy.optimize import minimize
+
+    fm, y = lr_problem
+    X = fm.to_dense()
+    reg = 0.05
+
+    def obj(beta):
+        z = X @ beta[:-1] + beta[-1]
+        ce = np.maximum(z, 0) - z * y + np.log1p(np.exp(-np.abs(z)))
+        return ce.mean() + 0.5 * reg * np.sum(beta[:-1] ** 2)
+
+    ref = minimize(obj, np.zeros(fm.num_features + 1), method="L-BFGS-B").fun
+    model = LogisticRegression(
+        max_iter=300, reg_param=reg, standardization=False
+    ).fit(fm, y)
+    assert model.train_loss == pytest.approx(ref, rel=1e-3)
+
+
+def test_lr_solvers_agree(lr_problem):
+    fm, y = lr_problem
+    a = LogisticRegression(max_iter=250, reg_param=0.05, solver="lbfgs").fit(fm, y)
+    b = LogisticRegression(max_iter=800, reg_param=0.05, solver="adam", learning_rate=0.05).fit(fm, y)
+    assert a.train_loss == pytest.approx(b.train_loss, rel=2e-2)
+
+
+def test_lr_separates_and_auc(lr_problem):
+    fm, y = lr_problem
+    model = LogisticRegression(max_iter=200, reg_param=0.01).fit(fm, y)
+    p = model.predict_proba(fm)
+    auc = area_under_roc(y, p)
+    assert auc > 0.85
+    acc = ((p > 0.5) == (y > 0.5)).mean()
+    assert acc > 0.8
+
+
+def test_lr_sample_weights_shift_decision(rng):
+    # All-positive-weighted fit should push probabilities up vs balanced.
+    fm = make_fm(rng, n=600)
+    y = (rng.random(600) < 0.5).astype(np.float32)
+    w_pos = np.where(y == 1.0, 0.9, 0.1).astype(np.float32)
+    base = LogisticRegression(max_iter=100, reg_param=0.1).fit(fm, y)
+    tilted = LogisticRegression(max_iter=100, reg_param=0.1).fit(fm, y, sample_weight=w_pos)
+    assert tilted.predict_proba(fm).mean() > base.predict_proba(fm).mean() + 0.1
+
+
+def test_lr_standardization_freezes_constant_features(rng):
+    fm = make_fm(rng, n=300)
+    fm.dense[:, 0] = 5.0  # constant column -> scale 0 -> zero raw coefficient
+    y = (rng.random(300) < 0.5).astype(np.float32)
+    model = LogisticRegression(max_iter=50, reg_param=0.1).fit(fm, y)
+    assert model.coefficients["dense"][0] == 0.0
+
+
+def test_fold_scales_roundtrip(rng):
+    import jax
+
+    fm = make_fm(rng, n=200)
+    y = (rng.random(200) < 0.5).astype(np.float32)
+    model = LogisticRegression(max_iter=30, reg_param=0.1).fit(fm, y)
+    folded = fold_scales(model.params, model.scales)
+    ones = jax.tree.map(lambda p: np.ones_like(np.asarray(p)), model.params)
+    a = np.asarray(block_logits(folded, ones, feature_batch(fm)))
+    b = model.decision_function(fm)
+    np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5)
+
+
+# --- word2vec ----------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def w2v_clusters():
+    rng = np.random.default_rng(0)
+    a = ["apple", "banana", "cherry", "grape"]
+    b = ["python", "jax", "compiler", "kernel"]
+    sentences = []
+    for _ in range(500):
+        pool = a if rng.random() < 0.5 else b
+        sentences.append([pool[i] for i in rng.integers(0, 4, size=6)])
+    model = Word2Vec(
+        dim=16, window=3, min_count=1, max_iter=25, batch_size=512,
+        subsample=0.0, seed=1,
+    ).fit_corpus(sentences)
+    return a, b, model
+
+
+def test_w2v_clusters_separate(w2v_clusters):
+    a, b, model = w2v_clusters
+    v = model.vectors / (np.linalg.norm(model.vectors, axis=1, keepdims=True) + 1e-9)
+    idx = {w: i for i, w in enumerate(model.vocab)}
+    within = np.mean([v[idx[x]] @ v[idx[y]] for x in a for y in a if x != y])
+    across = np.mean([v[idx[x]] @ v[idx[y]] for x in a for y in b])
+    assert within > 0.8
+    assert across < 0.5
+
+
+def test_w2v_synonyms(w2v_clusters):
+    a, _, model = w2v_clusters
+    syn = [w for w, _ in model.find_synonyms("apple", k=3)]
+    assert set(syn) <= set(a) - {"apple"}
+
+
+def test_w2v_document_vector_and_transform(w2v_clusters):
+    _, _, model = w2v_clusters
+    dv = model.document_vector(["apple", "oov-token"])
+    np.testing.assert_allclose(dv, model.vector("apple"))
+    assert (model.document_vector(["oov-token"]) == 0).all()
+
+    df = pd.DataFrame({"words": [["apple", "banana"], []]})
+    model.input_col = "words"
+    model.output_col = "words__w2v"
+    out = model.transform(df)
+    np.testing.assert_allclose(
+        out["words__w2v"][0],
+        (model.vector("apple") + model.vector("banana")) / 2,
+        rtol=1e-6,
+    )
+
+
+def test_w2v_min_count_filters_vocab():
+    sentences = [["common", "common", "rare"], ["common", "words", "words"]]
+    m = Word2Vec(dim=4, min_count=2, max_iter=1, subsample=0.0).fit_corpus(sentences)
+    assert "rare" not in m.vocab
+    assert "common" in m.vocab
+
+
+def test_w2v_deterministic():
+    sentences = [["x", "y", "z", "x", "y"]] * 50
+    kw = dict(dim=8, min_count=1, max_iter=3, subsample=0.0, seed=5, batch_size=64)
+    m1 = Word2Vec(**kw).fit_corpus(sentences)
+    m2 = Word2Vec(**kw).fit_corpus(sentences)
+    np.testing.assert_array_equal(m1.vectors, m2.vectors)
