@@ -1,0 +1,20 @@
+"""Entry-point builders (L4): the per-workload jobs the CLI launches.
+
+Reference parity: the top-level ``object ... { def main }`` classes in
+``src/main/scala/ws/vinta/albedo/`` (``PopularityRecommenderBuilder``,
+``UserProfileBuilder``, ``RepoProfileBuilder``, ``ALSRecommenderBuilder``,
+``Word2VecCorpusBuilder``, ``LogisticRegressionRanker``, the CV variants) and
+the Makefile targets that submit them (``Makefile:131-218``).
+"""
+
+from albedo_tpu.builders.profiles import (
+    FeatureColumns,
+    build_repo_profile,
+    build_user_profile,
+)
+
+__all__ = [
+    "FeatureColumns",
+    "build_repo_profile",
+    "build_user_profile",
+]

@@ -1,0 +1,29 @@
+"""Candidate-generation recommenders (L3).
+
+Reference parity: ``src/main/scala/ws/vinta/albedo/recommenders/`` — the
+abstract ``Recommender extends Transformer`` with ``recommendForUsers`` plus
+four concrete sources (als, popularity, curation, content) whose outputs the
+ranker fuses (``LogisticRegressionRanker.scala:368-404``).
+"""
+
+from albedo_tpu.recommenders.als import ALSRecommender
+from albedo_tpu.recommenders.base import Recommender, fuse_candidates
+from albedo_tpu.recommenders.content import (
+    ContentRecommender,
+    EmbeddingSearchBackend,
+    SearchBackend,
+)
+from albedo_tpu.recommenders.curation import CURATOR_IDS, CurationRecommender
+from albedo_tpu.recommenders.popularity import PopularityRecommender
+
+__all__ = [
+    "ALSRecommender",
+    "CURATOR_IDS",
+    "ContentRecommender",
+    "CurationRecommender",
+    "EmbeddingSearchBackend",
+    "PopularityRecommender",
+    "Recommender",
+    "SearchBackend",
+    "fuse_candidates",
+]

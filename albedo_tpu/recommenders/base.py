@@ -1,0 +1,61 @@
+"""Abstract recommender: the candidate-generation contract.
+
+Reference parity: ``recommenders/Recommender.scala:9-68`` — a Transformer with
+``userCol/itemCol/scoreCol/sourceCol/topK`` params whose ``transform`` simply
+delegates to ``recommendForUsers(userDF)``; every source tags its rows so the
+fused candidate set remembers provenance.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pandas as pd
+
+from albedo_tpu.features.pipeline import Transformer
+
+
+class Recommender(Transformer):
+    source: str = "unknown"
+
+    def __init__(
+        self,
+        user_col: str = "user_id",
+        item_col: str = "repo_id",
+        score_col: str = "score",
+        source_col: str = "source",
+        top_k: int = 15,
+    ):
+        self.user_col = user_col
+        self.item_col = item_col
+        self.score_col = score_col
+        self.source_col = source_col
+        self.top_k = top_k
+
+    def recommend_for_users(self, user_ids: np.ndarray) -> pd.DataFrame:
+        """Return a frame [user_col, item_col, score_col, source_col] with up
+        to ``top_k`` rows per requested (raw) user id."""
+        raise NotImplementedError
+
+    def transform(self, df: pd.DataFrame) -> pd.DataFrame:
+        self.require_cols(df, [self.user_col])
+        return self.recommend_for_users(df[self.user_col].to_numpy(np.int64))
+
+    def _frame(
+        self, users: np.ndarray, items: np.ndarray, scores: np.ndarray
+    ) -> pd.DataFrame:
+        return pd.DataFrame(
+            {
+                self.user_col: np.asarray(users, dtype=np.int64),
+                self.item_col: np.asarray(items, dtype=np.int64),
+                self.score_col: np.asarray(scores, dtype=np.float64),
+                self.source_col: self.source,
+            }
+        )
+
+
+def fuse_candidates(frames: list[pd.DataFrame], user_col: str = "user_id", item_col: str = "repo_id") -> pd.DataFrame:
+    """Union candidate sets and drop duplicate (user, item) pairs, keeping the
+    first source's row — the ranker's ``map(recommendForUsers).reduce(union)
+    .distinct`` fusion (``LogisticRegressionRanker.scala:397-404``)."""
+    out = pd.concat(frames, ignore_index=True)
+    return out.drop_duplicates([user_col, item_col], keep="first").reset_index(drop=True)
