@@ -12,9 +12,27 @@ from albedo_tpu.builders.profiles import (
     build_repo_profile,
     build_user_profile,
 )
+from albedo_tpu.builders.ranker import (
+    ALSScorer,
+    RankerConfig,
+    RankerModel,
+    RankerResult,
+    build_feature_pipeline,
+    reduce_starring,
+    train_ranker,
+)
+
+from albedo_tpu.builders import jobs as _jobs  # noqa: F401  (registers CLI jobs)
 
 __all__ = [
+    "ALSScorer",
     "FeatureColumns",
+    "RankerConfig",
+    "RankerModel",
+    "RankerResult",
+    "build_feature_pipeline",
     "build_repo_profile",
     "build_user_profile",
+    "reduce_starring",
+    "train_ranker",
 ]

@@ -27,6 +27,15 @@ def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(prog="albedo-tpu")
     parser.add_argument("job", choices=sorted(_JOBS) or ["none"], help="job to run")
     parser.add_argument("--small", action="store_true", help="laptop-scale run")
+    parser.add_argument(
+        "--tables",
+        default=None,
+        help="raw-table source: CSV/parquet directory or sqlite db "
+        "(default: deterministic synthetic tables)",
+    )
+    parser.add_argument(
+        "--now", type=float, default=None, help="epoch seconds for date features"
+    )
     args, _rest = parser.parse_known_args(argv)
     if args.job not in _JOBS:
         print(f"no such job: {args.job}", file=sys.stderr)
@@ -39,8 +48,17 @@ def _load_builders() -> None:
     try:
         import albedo_tpu.builders  # noqa: F401  (registers jobs on import)
     except ImportError:
-        pass
+        # Surface the real failure — a swallowed import error would otherwise
+        # masquerade as "no such job".
+        import traceback
+
+        print("warning: failed to load builder jobs:", file=sys.stderr)
+        traceback.print_exc()
 
 
 if __name__ == "__main__":
-    sys.exit(main())
+    # Under `python -m albedo_tpu.cli` this file runs as `__main__`, but jobs
+    # register into the canonical `albedo_tpu.cli` module — delegate to it.
+    from albedo_tpu.cli import main as _canonical_main
+
+    sys.exit(_canonical_main())

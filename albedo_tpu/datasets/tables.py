@@ -265,7 +265,9 @@ def load_raw_tables(source: str | Path) -> RawTables:
     return RawTables(**out)
 
 
-def load_or_create_raw_tables(create: Callable[[], RawTables]) -> RawTables:
+def load_or_create_raw_tables(
+    create: Callable[[], RawTables], key: str = "raw_tables.pkl"
+) -> RawTables:
     """Date-keyed memoization of the conformed tables (the ``rawUserInfoDF.parquet``
     caching idiom, ``utils/DatasetUtils.scala:52-133``). All four tables live in
     ONE artifact so a killed job can never resume with a torn set (user_info
@@ -276,5 +278,5 @@ def load_or_create_raw_tables(create: Callable[[], RawTables]) -> RawTables:
         t = create().conformed()
         return {key: getattr(t, key) for key in _TABLE_FILES}
 
-    frames = load_or_create_pickle("raw_tables.pkl", _create)
+    frames = load_or_create_pickle(key, _create)
     return RawTables(**frames)

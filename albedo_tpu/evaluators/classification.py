@@ -11,13 +11,14 @@ import numpy as np
 
 
 def area_under_roc(
-    scores: np.ndarray, labels: np.ndarray, weights: np.ndarray | None = None
+    labels: np.ndarray, scores: np.ndarray, weights: np.ndarray | None = None
 ) -> float:
     """Exact AUC via the rank statistic with average ranks on ties.
 
+    Argument order follows sklearn's ``roc_auc_score(y_true, y_score)``.
     Equivalent to the trapezoidal area under the ROC curve with score-grouped
     thresholds (what Spark's evaluator computes), including optional instance
-    weights.
+    weights. Returns nan when only one class is present.
     """
     scores = np.asarray(scores, dtype=np.float64)
     labels = np.asarray(labels, dtype=np.float64)

@@ -100,6 +100,14 @@ class LogisticRegression:
         )
 
 
+def _finite_tree(tree) -> jax.Array:
+    leaves = jax.tree.leaves(tree)
+    ok = jnp.bool_(True)
+    for leaf in leaves:
+        ok = ok & jnp.all(jnp.isfinite(leaf))
+    return ok
+
+
 def _run_lbfgs(loss_fn, params: Params, max_iter: int, tol: float):
     opt = optax.lbfgs()
     value_and_grad = optax.value_and_grad_from_state(loss_fn)
@@ -109,25 +117,38 @@ def _run_lbfgs(loss_fn, params: Params, max_iter: int, tol: float):
         state = opt.init(params)
 
         def step(carry):
-            params, state, _prev, i = carry
+            params, state, _prev, i, _bad = carry
             value, grad = value_and_grad(params, state=state)
             updates, state = opt.update(
                 grad, state, params, value=value, grad=grad, value_fn=loss_fn
             )
-            params = optax.apply_updates(params, updates)
-            return params, state, value, i + 1
+            new_params = optax.apply_updates(params, updates)
+            # A line-search overshoot can yield non-finite iterates (seen
+            # nondeterministically with extreme instance weights); keep the
+            # last finite point and stop instead of propagating nan.
+            ok = jnp.isfinite(value) & _finite_tree(new_params)
+            kept = jax.tree.map(
+                lambda n, o: jnp.where(ok, n, o), new_params, params
+            )
+            return kept, state, value, i + 1, ~ok
 
         def cont(carry):
-            params, state, prev, i = carry
+            params, state, prev, i, bad = carry
             value = optax.tree.get(state, "value")
             grad = optax.tree.get(state, "grad")
             gnorm = optax.tree.norm(grad)
-            # Keep iterating while under budget and not converged.
-            return (i < max_iter) & ((i < 2) | ((jnp.abs(prev - value) > tol * jnp.abs(value)) & (gnorm > tol)))
+            # Keep iterating while finite, under budget, and not converged.
+            return (
+                ~bad
+                & (i < max_iter)
+                & ((i < 2) | ((jnp.abs(prev - value) > tol * jnp.abs(value)) & (gnorm > tol)))
+            )
 
-        init = (params, state, jnp.inf, 0)
-        params, state, value, _ = jax.lax.while_loop(cont, step, init)
-        return params, value
+        init = (params, state, jnp.inf, 0, jnp.bool_(False))
+        params, state, value, _, _ = jax.lax.while_loop(cont, step, init)
+        # Report the loss at the returned (finite) point, not the last
+        # line-search value.
+        return params, loss_fn(params)
 
     return run(params)
 

@@ -127,6 +127,10 @@ def weighted_logloss(
     """MLlib objective: (sum_i w_i * ce_i) / sum_i w_i + 0.5 * reg * ||beta_std||^2
     (bias unpenalized)."""
     logits = block_logits(params, scales, batch)
+    # Straight-through clip: cap the CE value so an L-BFGS line-search
+    # overshoot can't produce inf - inf = nan, while keeping the gradient of
+    # out-of-range (badly misclassified) samples alive.
+    logits = logits + jax.lax.stop_gradient(jnp.clip(logits, -35.0, 35.0) - logits)
     ce = jnp.maximum(logits, 0) - logits * labels + jnp.log1p(jnp.exp(-jnp.abs(logits)))
     data = jnp.sum(weights * ce) / jnp.sum(weights)
     pen = sum(

@@ -141,7 +141,7 @@ def test_auc_pairwise_reference(rng):
     pos, neg = scores[labels > 0], scores[labels <= 0]
     cmp = (pos[:, None] > neg[None, :]).sum() + 0.5 * (pos[:, None] == neg[None, :]).sum()
     want = cmp / (len(pos) * len(neg))
-    assert area_under_roc(scores, labels) == pytest.approx(want, abs=1e-9)
+    assert area_under_roc(labels, scores) == pytest.approx(want, abs=1e-9)
 
 
 def test_auc_weighted_ties(rng):
@@ -155,4 +155,4 @@ def test_auc_weighted_ties(rng):
     num = (wp[:, None] * wn[None, :] * (sp[:, None] > sn[None, :])).sum()
     num += 0.5 * (wp[:, None] * wn[None, :] * (sp[:, None] == sn[None, :])).sum()
     want = num / (wp.sum() * wn.sum())
-    assert area_under_roc(scores, labels, weights) == pytest.approx(want, abs=1e-9)
+    assert area_under_roc(labels, scores, weights) == pytest.approx(want, abs=1e-9)
