@@ -338,6 +338,43 @@ def train_lr_job(args) -> None:
     _report("train_lr", "NDCG@30", result.ndcg or 0.0, t0)
 
 
+@register_job("collect_data")
+def collect_data_job(args) -> None:
+    """``collect_data`` Django command parity: crawl GitHub into a sqlite
+    store. Requires network unless a fake transport is injected in tests.
+
+    Extra flags (parsed here): --db PATH, --seed-users a,b,c, --token T[,T2].
+    """
+    from albedo_tpu.store import EntityStore, GitHubCrawler
+
+    t0 = time.time()
+    extra = argparse.ArgumentParser()
+    extra.add_argument("--db", default="albedo-crawl.db")
+    extra.add_argument("--seed-users", default="vinta")
+    extra.add_argument("--token", default="")
+    ns, _ = extra.parse_known_args(getattr(args, "_rest", []))
+    with EntityStore(ns.db) as store:
+        crawler = GitHubCrawler(store, tokens=ns.token.split(","))
+        stats = crawler.collect([u for u in ns.seed_users.split(",") if u])
+        print(f"[collect_data] {stats}")
+    _report("collect_data", "requests", float(stats.requests), t0)
+
+
+@register_job("sync_index")
+def sync_index_job(args) -> None:
+    """``sync_data_to_es`` parity: build the content embedding index."""
+    from albedo_tpu.store import build_content_index
+
+    t0 = time.time()
+    ctx = JobContext(args)
+    lo, hi = (10, 290_000) if getattr(args, "tables", None) else (1, 10**9)
+    backend = build_content_index(
+        ctx.tables().repo_info, ctx.word2vec(), min_stars=lo, max_stars=hi,
+        artifact_name=ctx.artifact_name("contentIndex.npz"),
+    )
+    _report("sync_index", "indexed_repos", float(len(backend.item_ids)), t0)
+
+
 @register_job("cv_lr")
 def cv_lr_job(args) -> None:
     """``LogisticRegressionRankerCV`` — grid over instance-weight columns."""

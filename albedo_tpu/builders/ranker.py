@@ -176,7 +176,9 @@ def build_feature_pipeline(
 
     vec_out = []
     for col in [*user_cols.text, *repo_cols.text]:
-        stages.append(Tokenizer(col, f"{col}__words", remove_stop_words=True))
+        # Tokenizer -> StopWordsRemover staging as the reference (:200-216);
+        # stop-word removal happens in the remover stage, not both.
+        stages.append(Tokenizer(col, f"{col}__words", remove_stop_words=False))
         stages.append(StopWordsRemover(f"{col}__words", f"{col}__filtered"))
         w2v_stage = dataclasses.replace(
             w2v, input_col=f"{col}__filtered", output_col=f"{col}__w2v"

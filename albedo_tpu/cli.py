@@ -37,6 +37,7 @@ def main(argv: list[str] | None = None) -> int:
         "--now", type=float, default=None, help="epoch seconds for date features"
     )
     args, _rest = parser.parse_known_args(argv)
+    args._rest = _rest  # job-specific flags (e.g. collect_data --db/--token)
     if args.job not in _JOBS:
         print(f"no such job: {args.job}", file=sys.stderr)
         return 2

@@ -62,6 +62,10 @@ class LogisticRegression:
     solver: str = "lbfgs"      # "lbfgs" (MLlib parity) or "adam"
     learning_rate: float = 0.05  # adam only
     tol: float = 1e-7
+    # Optional jax.sharding.Mesh: lay the batch out row-sharded over the
+    # mesh's "data" axis (albedo_tpu.parallel.lr) — XLA then inserts the ICI
+    # psums that replace MLlib's gradient treeAggregate.
+    mesh: Any | None = None
 
     def fit(
         self,
@@ -72,9 +76,14 @@ class LogisticRegression:
         n = fm.n_rows
         if sample_weight is None:
             sample_weight = np.ones(n, dtype=np.float32)
-        batch = feature_batch(fm)
-        y = jnp.asarray(labels, dtype=jnp.float32)
-        w = jnp.asarray(sample_weight, dtype=jnp.float32)
+        if self.mesh is not None:
+            from albedo_tpu.parallel.lr import shard_feature_batch
+
+            batch, y, w = shard_feature_batch(fm, labels, sample_weight, self.mesh)
+        else:
+            batch = feature_batch(fm)
+            y = jnp.asarray(labels, dtype=jnp.float32)
+            w = jnp.asarray(sample_weight, dtype=jnp.float32)
 
         if self.standardization:
             scales = jax.tree.map(jnp.asarray, inverse_std_scales(fm))

@@ -6,6 +6,8 @@ Spark driver/executor runtime (SURVEY.md sections 2.5, 7).
 - ``als`` — shard_map'd data-parallel ALS bucket solves + psum Gramian for
   sharded factor storage.
 - ``topk`` — item-axis-sharded retrieval with k-per-device candidate merge.
+- ``lr`` — row-sharded feature batches for data-parallel LR training (psum
+  gradient reductions = MLlib's treeAggregate).
 """
 
 from albedo_tpu.parallel.mesh import (  # noqa: F401
@@ -26,3 +28,4 @@ from albedo_tpu.parallel.topk import (  # noqa: F401
     make_sharded_topk,
     sharded_topk_scores,
 )
+from albedo_tpu.parallel.lr import shard_feature_batch  # noqa: F401

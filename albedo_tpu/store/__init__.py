@@ -1,0 +1,22 @@
+"""Data-acquisition layer (L0): entity store, GitHub crawler, content index.
+
+Reference parity: the Django app — ORM models with upsert helpers and unique
+constraints (``app/models.py:9-190``), the ``collect_data`` crawling command
+(``app/management/commands/collect_data.py``), ``sync_data_to_es``
+(``app/management/commands/sync_data_to_es.py``), and ``drop_data``. MySQL is
+replaced by sqlite (stdlib, serverless); Elasticsearch by the embedding
+content index consumed by ``recommenders.content``.
+"""
+
+from albedo_tpu.store.crawler import CrawlStats, GitHubCrawler, RateLimited
+from albedo_tpu.store.index import build_content_index, load_content_index
+from albedo_tpu.store.store import EntityStore
+
+__all__ = [
+    "CrawlStats",
+    "EntityStore",
+    "GitHubCrawler",
+    "RateLimited",
+    "build_content_index",
+    "load_content_index",
+]

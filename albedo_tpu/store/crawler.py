@@ -1,0 +1,285 @@
+"""GitHub crawler: BFS over the follow graph collecting stars and metadata.
+
+Reference parity: ``app/management/commands/collect_data.py:36-215`` —
+``GitHubCrawler`` with a rotating token pool (:46-48), rate-limit handling
+(403 -> sleep 30 minutes and retry, :60-66), bounded retries (:50), paginated
+fetches on a 6-worker thread pool (:85-101), and the BFS:
+
+1. per seed user: following + followers (writes ``UserRelation`` edges) and
+   starred repos (writes ``RepoStarring``),
+2. every discovered username without a ``UserInfo`` row: fetch profile +
+   starred repos (:200-202),
+3. every starred repo id without a ``RepoInfo`` row: fetch metadata (:211-213).
+
+Dedup is the store's unique constraints, as the reference swallows
+``IntegrityError``. The HTTP layer is an injected ``transport`` callable so
+the crawler is fully testable offline (this environment has no egress); the
+default transport uses ``urllib`` against api.github.com.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import datetime as _dt
+import json as _json
+import random
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Callable, Iterable
+
+from albedo_tpu.store.store import EntityStore
+
+Transport = Callable[[str, dict[str, Any], str | None], tuple[int, Any]]
+
+RATE_LIMIT_SLEEP_S = 30 * 60  # :60-66
+MAX_RETRIES = 5
+PER_PAGE = 100
+CONCURRENCY = 6  # ThreadPoolExecutor(6), :85
+
+
+class RateLimited(Exception):
+    pass
+
+
+def default_transport(path: str, params: dict[str, Any], token: str | None) -> tuple[int, Any]:
+    """GET api.github.com/<path> with urllib (real-network path)."""
+    import urllib.parse
+    import urllib.request
+
+    url = f"https://api.github.com{path}"
+    if params:
+        url += "?" + urllib.parse.urlencode(params)
+    req = urllib.request.Request(url)
+    req.add_header("Accept", "application/vnd.github.star+json")
+    if token:
+        req.add_header("Authorization", f"token {token}")
+    try:
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            return resp.status, _json.loads(resp.read().decode("utf-8"))
+    except Exception as e:  # urllib raises on 4xx/5xx
+        status = getattr(e, "code", 599)
+        return int(status), None
+
+
+def _epoch(iso: str | float | None) -> float:
+    if iso is None:
+        return 0.0
+    if isinstance(iso, (int, float)):
+        return float(iso)
+    try:
+        return _dt.datetime.fromisoformat(str(iso).replace("Z", "+00:00")).timestamp()
+    except ValueError:
+        return 0.0
+
+
+def _user_row(u: dict[str, Any]) -> dict[str, Any]:
+    return {
+        "id": int(u["id"]),
+        "login": u.get("login", ""),
+        "account_type": u.get("type", "User"),
+        "name": u.get("name") or "",
+        "company": u.get("company") or "",
+        "blog": u.get("blog") or "",
+        "location": u.get("location") or "",
+        "email": u.get("email") or "",
+        "bio": u.get("bio") or "",
+        "public_repos": int(u.get("public_repos") or 0),
+        "public_gists": int(u.get("public_gists") or 0),
+        "followers": int(u.get("followers") or 0),
+        "following": int(u.get("following") or 0),
+        "created_at": _epoch(u.get("created_at")),
+        "updated_at": _epoch(u.get("updated_at")),
+    }
+
+
+def _repo_row(r: dict[str, Any]) -> dict[str, Any]:
+    owner = r.get("owner") or {}
+    topics = r.get("topics") or []
+    return {
+        "id": int(r["id"]),
+        "owner_id": int(owner.get("id") or 0),
+        "owner_username": owner.get("login", ""),
+        "owner_type": owner.get("type", "User"),
+        "name": r.get("name", ""),
+        "full_name": r.get("full_name", ""),
+        "description": r.get("description") or "",
+        "language": r.get("language") or "",
+        "created_at": _epoch(r.get("created_at")),
+        "updated_at": _epoch(r.get("updated_at")),
+        "pushed_at": _epoch(r.get("pushed_at")),
+        "homepage": r.get("homepage") or "",
+        "size": int(r.get("size") or 0),
+        "stargazers_count": int(r.get("stargazers_count") or 0),
+        "forks_count": int(r.get("forks_count") or 0),
+        "subscribers_count": int(r.get("subscribers_count") or 0),
+        "fork": int(bool(r.get("fork"))),
+        "has_issues": int(bool(r.get("has_issues"))),
+        "has_projects": int(bool(r.get("has_projects"))),
+        "has_downloads": int(bool(r.get("has_downloads"))),
+        "has_wiki": int(bool(r.get("has_wiki"))),
+        "has_pages": int(bool(r.get("has_pages"))),
+        "open_issues_count": int(r.get("open_issues_count") or 0),
+        "topics": ",".join(topics) if isinstance(topics, list) else str(topics),
+    }
+
+
+@dataclasses.dataclass
+class CrawlStats:
+    requests: int = 0
+    rate_limit_sleeps: int = 0
+    users: int = 0
+    repos: int = 0
+    starrings: int = 0
+    relations: int = 0
+
+
+class GitHubCrawler:
+    def __init__(
+        self,
+        store: EntityStore,
+        tokens: Iterable[str] = ("",),
+        transport: Transport = default_transport,
+        sleeper: Callable[[float], None] = time.sleep,
+        max_pages: int = 50,
+        concurrency: int = CONCURRENCY,
+        seed: int = 0,
+    ):
+        self.store = store
+        self.tokens = list(tokens) or [""]
+        self.transport = transport
+        self.sleeper = sleeper
+        self.max_pages = max_pages
+        self.concurrency = concurrency
+        self.stats = CrawlStats()
+        self._rng = random.Random(seed)
+        # _request runs on the page-fetch pool: stats increments and the
+        # shared rng need a lock (Python += is not atomic).
+        self._lock = threading.Lock()
+        self._pool = ThreadPoolExecutor(concurrency)
+
+    # --- request core (:50-68) ----------------------------------------------
+
+    def _request(self, path: str, params: dict[str, Any] | None = None) -> Any:
+        params = params or {}
+        for _attempt in range(MAX_RETRIES):
+            with self._lock:
+                token = self._rng.choice(self.tokens)
+                self.stats.requests += 1
+            status, data = self.transport(path, params, token or None)
+            if status == 200:
+                return data
+            if status == 403:  # rate limited -> sleep it out and retry
+                with self._lock:
+                    self.stats.rate_limit_sleeps += 1
+                self.sleeper(RATE_LIMIT_SLEEP_S)
+                continue
+            if status == 404:
+                return None
+            self.sleeper(1.0)
+        raise RateLimited(f"giving up on {path} after {MAX_RETRIES} attempts")
+
+    def _fetch_pages(self, path: str, fetch_more: bool = True) -> list[Any]:
+        """Paginated fetch on a thread pool (:85-101). Stops at the first
+        empty page (sequential probe first, then the pool for the rest)."""
+        first = self._request(path, {"page": 1, "per_page": PER_PAGE}) or []
+        items = list(first)
+        if len(first) < PER_PAGE or not fetch_more:
+            return items
+        page = 2
+        while page <= self.max_pages:
+            batch = list(range(page, min(page + self.concurrency, self.max_pages + 1)))
+            results = list(
+                self._pool.map(
+                    lambda p: self._request(path, {"page": p, "per_page": PER_PAGE})
+                    or [],
+                    batch,
+                )
+            )
+            done = False
+            for r in results:
+                items.extend(r)
+                if len(r) < PER_PAGE:
+                    done = True
+                    break
+            if done:
+                break
+            page = batch[-1] + 1
+        return items
+
+    # --- entity fetchers -----------------------------------------------------
+
+    def fetch_user_info(self, username: str) -> dict | None:
+        u = self._request(f"/users/{username}")
+        if u is None:
+            return None
+        self.store.upsert_user(_user_row(u))
+        self.stats.users += 1
+        return u
+
+    def fetch_repo_info(self, repo_id: int) -> dict | None:
+        r = self._request(f"/repositories/{int(repo_id)}")
+        if r is None:
+            return None
+        self.store.upsert_repo(_repo_row(r))
+        self.stats.repos += 1
+        return r
+
+    def fetch_following_users(self, username: str, user_id: int, fetch_more: bool = True) -> list[str]:
+        found = []
+        for u in self._fetch_pages(f"/users/{username}/following", fetch_more):
+            self.store.add_relation(
+                user_id, int(u["id"]), "follow", username, u.get("login", "")
+            )
+            self.stats.relations += 1
+            found.append(u.get("login", ""))
+        return found
+
+    def fetch_follower_users(self, username: str, user_id: int, fetch_more: bool = True) -> list[str]:
+        found = []
+        for u in self._fetch_pages(f"/users/{username}/followers", fetch_more):
+            self.store.add_relation(
+                int(u["id"]), user_id, "follow", u.get("login", ""), username
+            )
+            self.stats.relations += 1
+            found.append(u.get("login", ""))
+        return found
+
+    def fetch_starred_repos(self, username: str, user_id: int, fetch_more: bool = True) -> list[int]:
+        repo_ids = []
+        for item in self._fetch_pages(f"/users/{username}/starred", fetch_more):
+            repo = item.get("repo", item)  # star+json wraps; plain json doesn't
+            self.store.upsert_repo(_repo_row(repo))
+            self.store.add_starring(user_id, int(repo["id"]), _epoch(item.get("starred_at")))
+            self.stats.starrings += 1
+            repo_ids.append(int(repo["id"]))
+        return repo_ids
+
+    # --- the BFS (handle(), :173-215) ----------------------------------------
+
+    def collect(self, seed_usernames: Iterable[str], fetch_more: bool = True) -> CrawlStats:
+        for username in seed_usernames:
+            u = self.fetch_user_info(username)
+            if u is None:
+                continue
+            uid = int(u["id"])
+            self.fetch_following_users(username, uid, fetch_more=fetch_more)
+            self.fetch_follower_users(username, uid, fetch_more=fetch_more)
+            self.fetch_starred_repos(username, uid, fetch_more=fetch_more)
+        self.store.commit()
+
+        # Discovered users without a profile: fetch info + their stars (:200-202).
+        known = self.store.usernames()
+        for username in sorted(self.store.relation_usernames() - known):
+            u = self.fetch_user_info(username)
+            if u is None:
+                continue
+            self.fetch_starred_repos(username, int(u["id"]), fetch_more=False)
+        self.store.commit()
+
+        # Starred repos without metadata (:211-213).
+        missing = self.store.starred_repo_ids() - self.store.repo_ids()
+        for repo_id in sorted(missing):
+            self.fetch_repo_info(repo_id)
+        self.store.commit()
+        return self.stats

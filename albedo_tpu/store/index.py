@@ -1,0 +1,63 @@
+"""Content-index sync: the ``sync_data_to_es`` replacement.
+
+Reference parity: ``app/management/commands/sync_data_to_es.py:9-50`` exports
+``RepoInfo`` rows (10 <= stars <= 290000, non-fork, :18) into the
+Elasticsearch ``repo`` index in batches, with a custom text analyzer
+(``app/mappings.py:17-23``). Here the "index" is the embedding table the
+``EmbeddingSearchBackend`` queries on device: repo text is tokenized
+(html-agnostic lowercase + stop-word removal, the analyzer's moral
+equivalent), embedded with Word2Vec, L2-normalized, and persisted as a
+date-keyed artifact.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pandas as pd
+
+from albedo_tpu.datasets.artifacts import load_or_create_npz
+from albedo_tpu.recommenders.content import EmbeddingSearchBackend
+
+
+def _eligible(repo_info: pd.DataFrame, min_stars: int, max_stars: int) -> pd.DataFrame:
+    return repo_info[
+        repo_info["repo_stargazers_count"].between(min_stars, max_stars)
+        & ~repo_info["repo_is_fork"]
+    ].reset_index(drop=True)
+
+
+def build_content_index(
+    repo_info: pd.DataFrame,
+    word2vec_model,
+    min_stars: int = 10,
+    max_stars: int = 290_000,
+    artifact_name: str | None = None,
+) -> EmbeddingSearchBackend:
+    """Embed eligible repos; optionally memoize vectors as an npz artifact."""
+    eligible = _eligible(repo_info, min_stars, max_stars)
+
+    def create() -> dict[str, np.ndarray]:
+        backend = EmbeddingSearchBackend(eligible, word2vec_model)
+        return {"item_ids": backend.item_ids, "vectors": backend.vectors}
+
+    if artifact_name is None:
+        arrays = create()
+    else:
+        arrays = load_or_create_npz(artifact_name, create)
+    return _backend_from_arrays(arrays)
+
+
+def load_content_index(artifact_name: str) -> EmbeddingSearchBackend:
+    arrays = load_or_create_npz(
+        artifact_name,
+        lambda: (_ for _ in ()).throw(FileNotFoundError(artifact_name)),
+    )
+    return _backend_from_arrays(arrays)
+
+
+def _backend_from_arrays(arrays: dict[str, np.ndarray]) -> EmbeddingSearchBackend:
+    backend = EmbeddingSearchBackend.__new__(EmbeddingSearchBackend)
+    backend.item_ids = np.asarray(arrays["item_ids"], dtype=np.int64)
+    backend.vectors = np.asarray(arrays["vectors"], dtype=np.float32)
+    backend._row = {int(i): r for r, i in enumerate(backend.item_ids)}
+    return backend

@@ -1,0 +1,190 @@
+"""Sqlite-backed entity store.
+
+Reference parity: the Django ORM models and their idempotent ``create_one``
+helpers — ``UserInfo``/``RepoInfo``/``UserRelation``/``RepoStarring`` with
+unique constraints ``(from_user_id, relation, to_user_id)`` and
+``(user_id, repo_id)`` (``app/models.py:9-190``); duplicate inserts are
+swallowed like the reference's caught ``IntegrityError`` (:52-55,187-190),
+which is what makes the crawler's BFS re-visits safe. ``drop_data`` truncates
+(``app/management/commands/drop_data.py:11-13``).
+
+Table names match the Django ones (``app_userinfo``...), so a store file is
+directly ingestible by ``datasets.load_raw_tables``.
+"""
+
+from __future__ import annotations
+
+import sqlite3
+from pathlib import Path
+from typing import Any, Iterable
+
+import pandas as pd
+
+_SCHEMAS = {
+    "app_userinfo": """
+        CREATE TABLE IF NOT EXISTS app_userinfo (
+            id INTEGER PRIMARY KEY,
+            login TEXT NOT NULL,
+            account_type TEXT DEFAULT '',
+            name TEXT DEFAULT '',
+            company TEXT DEFAULT '',
+            blog TEXT DEFAULT '',
+            location TEXT DEFAULT '',
+            email TEXT DEFAULT '',
+            bio TEXT DEFAULT '',
+            public_repos INTEGER DEFAULT 0,
+            public_gists INTEGER DEFAULT 0,
+            followers INTEGER DEFAULT 0,
+            following INTEGER DEFAULT 0,
+            created_at REAL DEFAULT 0,
+            updated_at REAL DEFAULT 0
+        )""",
+    "app_repoinfo": """
+        CREATE TABLE IF NOT EXISTS app_repoinfo (
+            id INTEGER PRIMARY KEY,
+            owner_id INTEGER DEFAULT 0,
+            owner_username TEXT DEFAULT '',
+            owner_type TEXT DEFAULT '',
+            name TEXT DEFAULT '',
+            full_name TEXT DEFAULT '',
+            description TEXT DEFAULT '',
+            language TEXT DEFAULT '',
+            created_at REAL DEFAULT 0,
+            updated_at REAL DEFAULT 0,
+            pushed_at REAL DEFAULT 0,
+            homepage TEXT DEFAULT '',
+            size INTEGER DEFAULT 0,
+            stargazers_count INTEGER DEFAULT 0,
+            forks_count INTEGER DEFAULT 0,
+            subscribers_count INTEGER DEFAULT 0,
+            fork INTEGER DEFAULT 0,
+            has_issues INTEGER DEFAULT 0,
+            has_projects INTEGER DEFAULT 0,
+            has_downloads INTEGER DEFAULT 0,
+            has_wiki INTEGER DEFAULT 0,
+            has_pages INTEGER DEFAULT 0,
+            open_issues_count INTEGER DEFAULT 0,
+            topics TEXT DEFAULT ''
+        )""",
+    "app_repostarring": """
+        CREATE TABLE IF NOT EXISTS app_repostarring (
+            user_id INTEGER NOT NULL,
+            repo_id INTEGER NOT NULL,
+            starred_at REAL DEFAULT 0,
+            starring REAL DEFAULT 1.0,
+            UNIQUE (user_id, repo_id)
+        )""",
+    "app_userrelation": """
+        CREATE TABLE IF NOT EXISTS app_userrelation (
+            from_user_id INTEGER NOT NULL,
+            from_username TEXT DEFAULT '',
+            to_user_id INTEGER NOT NULL,
+            to_username TEXT DEFAULT '',
+            relation TEXT NOT NULL,
+            UNIQUE (from_user_id, relation, to_user_id)
+        )""",
+}
+
+
+class EntityStore:
+    """Idempotent writes + frame reads over the four crawl tables."""
+
+    def __init__(self, path: str | Path = ":memory:"):
+        self.path = str(path)
+        self._conn = sqlite3.connect(self.path)
+        for ddl in _SCHEMAS.values():
+            self._conn.execute(ddl)
+        self._conn.commit()
+
+    # --- writes (create_one parity: INSERT OR IGNORE = swallowed IntegrityError)
+
+    def upsert_user(self, user: dict[str, Any]) -> None:
+        self._insert("app_userinfo", user, replace=True)
+
+    def upsert_repo(self, repo: dict[str, Any]) -> None:
+        self._insert("app_repoinfo", repo, replace=True)
+
+    def add_starring(self, user_id: int, repo_id: int, starred_at: float = 0.0) -> None:
+        self._conn.execute(
+            "INSERT OR IGNORE INTO app_repostarring (user_id, repo_id, starred_at, starring)"
+            " VALUES (?, ?, ?, 1.0)",
+            (int(user_id), int(repo_id), float(starred_at)),
+        )
+
+    def add_relation(
+        self, from_user_id: int, to_user_id: int, relation: str,
+        from_username: str = "", to_username: str = "",
+    ) -> None:
+        self._conn.execute(
+            "INSERT OR IGNORE INTO app_userrelation"
+            " (from_user_id, from_username, to_user_id, to_username, relation)"
+            " VALUES (?, ?, ?, ?, ?)",
+            (int(from_user_id), from_username, int(to_user_id), to_username, relation),
+        )
+
+    def commit(self) -> None:
+        self._conn.commit()
+
+    def _insert(self, table: str, row: dict[str, Any], replace: bool) -> None:
+        cols = [c for c in row]
+        verb = "INSERT OR REPLACE" if replace else "INSERT OR IGNORE"
+        sql = (
+            f"{verb} INTO {table} ({', '.join(cols)})"
+            f" VALUES ({', '.join('?' for _ in cols)})"
+        )
+        self._conn.execute(sql, [row[c] for c in cols])
+
+    # --- reads
+
+    def frame(self, table: str) -> pd.DataFrame:
+        return pd.read_sql_query(f"SELECT * FROM {table}", self._conn)
+
+    def user_ids(self) -> set[int]:
+        return {r[0] for r in self._conn.execute("SELECT id FROM app_userinfo")}
+
+    def repo_ids(self) -> set[int]:
+        return {r[0] for r in self._conn.execute("SELECT id FROM app_repoinfo")}
+
+    def usernames(self) -> set[str]:
+        return {r[0] for r in self._conn.execute("SELECT login FROM app_userinfo")}
+
+    def starred_repo_ids(self) -> set[int]:
+        return {
+            r[0] for r in self._conn.execute("SELECT DISTINCT repo_id FROM app_repostarring")
+        }
+
+    def relation_usernames(self) -> set[str]:
+        """Every username discovered through follow edges (BFS frontier)."""
+        out = set()
+        for a, b in self._conn.execute(
+            "SELECT from_username, to_username FROM app_userrelation"
+        ):
+            if a:
+                out.add(a)
+            if b:
+                out.add(b)
+        return out
+
+    def counts(self) -> dict[str, int]:
+        return {
+            t: self._conn.execute(f"SELECT COUNT(*) FROM {t}").fetchone()[0]
+            for t in _SCHEMAS
+        }
+
+    # --- maintenance
+
+    def drop_data(self, tables: Iterable[str] | None = None) -> None:
+        """Truncate (``drop_data.py:11-13``)."""
+        for t in tables or _SCHEMAS:
+            self._conn.execute(f"DELETE FROM {t}")
+        self._conn.commit()
+
+    def close(self) -> None:
+        self._conn.commit()
+        self._conn.close()
+
+    def __enter__(self) -> "EntityStore":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -1,0 +1,13 @@
+"""Utility layer: profiling/timing harness and schema assertions."""
+
+from albedo_tpu.utils.profiling import Timer, profiler_trace, timed, timing
+from albedo_tpu.utils.schema import assert_columns, equals_ignore_nullability
+
+__all__ = [
+    "Timer",
+    "assert_columns",
+    "equals_ignore_nullability",
+    "profiler_trace",
+    "timed",
+    "timing",
+]

@@ -1,0 +1,95 @@
+"""Timing and profiling harness.
+
+Reference parity: the reference measures wall-clock by prefixing ``time`` on
+every spark-submit (``Makefile:64,78,131``) and decorating crawler methods
+with ``timing_decorator`` (``app/utils_timing.py:7-15``); deeper inspection
+goes through the Spark UI. Here timing is a first-class module (SURVEY.md §5):
+``timed``/``Timer`` synchronize device work (``block_until_ready``) so numbers
+mean what they say, and ``profiler_trace`` wraps the JAX profiler (the
+TensorBoard-viewable trace is the Spark-UI analogue).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+import time
+from typing import Any, Callable, Iterator
+
+import jax
+
+
+def _sync(value: Any) -> None:
+    """Block until every jax array in a pytree is computed."""
+    for leaf in jax.tree.leaves(value):
+        if hasattr(leaf, "block_until_ready"):
+            leaf.block_until_ready()
+
+
+class Timer:
+    """Accumulating named wall-clock sections.
+
+    >>> t = Timer()
+    >>> with t.section("sweep"):
+    ...     out = step()          # any jax outputs are synced on exit
+    >>> t.report()
+    """
+
+    def __init__(self) -> None:
+        self.totals: dict[str, float] = {}
+        self.counts: dict[str, int] = {}
+
+    @contextlib.contextmanager
+    def section(self, name: str, sync: Any = None) -> Iterator[None]:
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            _sync(sync)
+            dt = time.perf_counter() - t0
+            self.totals[name] = self.totals.get(name, 0.0) + dt
+            self.counts[name] = self.counts.get(name, 0) + 1
+
+    def report(self, printer: Callable[[str], None] = print) -> dict[str, float]:
+        for name in sorted(self.totals, key=self.totals.get, reverse=True):  # type: ignore[arg-type]
+            printer(
+                f"{name}: {self.totals[name]:.3f}s over {self.counts[name]} call(s)"
+            )
+        return dict(self.totals)
+
+
+@contextlib.contextmanager
+def timed(label: str, sync: Any = None, printer: Callable[[str], None] = print):
+    """One-shot timed block; syncs ``sync`` (a pytree of jax arrays) on exit."""
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        _sync(sync)
+        printer(f"[{label}] {time.perf_counter() - t0:.3f}s")
+
+
+def timing(fn: Callable) -> Callable:
+    """Decorator parity with the crawler's ``timing_decorator``
+    (``app/utils_timing.py:7-15``): prints the wall-clock of each call,
+    synchronizing any jax outputs first."""
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        t0 = time.perf_counter()
+        out = fn(*args, **kwargs)
+        _sync(out)
+        print(f"[{fn.__name__}] {time.perf_counter() - t0:.3f}s")
+        return out
+
+    return wrapper
+
+
+@contextlib.contextmanager
+def profiler_trace(log_dir: str, enabled: bool = True):
+    """JAX profiler trace (view in TensorBoard/XProf) — the Spark-UI analogue."""
+    if not enabled:
+        yield
+        return
+    with jax.profiler.trace(log_dir):
+        yield

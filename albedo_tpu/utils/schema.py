@@ -1,0 +1,34 @@
+"""Schema assertions for host tables.
+
+Reference parity: ``utils/SchemaUtils.scala:6-18`` (nullability-insensitive
+schema equality + column-type assertion) — the runtime contract checks the
+reference uses in place of tests (SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+import pandas as pd
+
+
+def equals_ignore_nullability(a: pd.DataFrame, b: pd.DataFrame) -> bool:
+    """Same column names and kinds (int/float/bool/object), ignoring the
+    nullable-vs-plain dtype distinction."""
+    if list(a.columns) != list(b.columns):
+        return False
+    for col in a.columns:
+        if a[col].dtype.kind != b[col].dtype.kind:
+            return False
+    return True
+
+
+def assert_columns(df: pd.DataFrame, expected: dict[str, str]) -> None:
+    """Require columns to exist with the given dtype kind
+    (``SchemaUtils.checkColumnType`` analogue)."""
+    for col, kind in expected.items():
+        if col not in df.columns:
+            raise ValueError(f"missing column {col!r}")
+        actual = df[col].dtype.kind
+        if actual != kind:
+            raise ValueError(
+                f"column {col!r} must have dtype kind {kind!r} but was {actual!r}"
+            )
