@@ -121,24 +121,56 @@ class JobContext:
             self._cache["profiles"] = (up, uc, rp, rc)
         return self._cache["profiles"]
 
+    def word2vec_corpus(self) -> list[list[str]]:
+        """The reference's W2V corpus (``Word2VecCorpusBuilder.scala:47-69``):
+        ``concat_ws(", ", login/name/bio/company/location)`` per user union
+        ``concat_ws(", ", owner/name/language/description/topics)`` per repo,
+        then the SAME Tokenizer -> StopWordsRemover stages the ranker's
+        inference pipeline applies, so corpus vocab and inference tokens
+        can never diverge (no punctuation-OOV)."""
+        import pandas as pd
+
+        from albedo_tpu.features.text import StopWordsRemover, Tokenizer
+
+        tables = self.tables()
+
+        def concat_ws(df, cols: list[str]):
+            parts = [df[c].fillna("").astype(str) for c in cols]
+            out = parts[0]
+            for p in parts[1:]:
+                out = out + ", " + p
+            return out
+
+        user_text = concat_ws(
+            tables.user_info,
+            ["user_login", "user_name", "user_bio", "user_company", "user_location"],
+        )
+        repo_text = concat_ws(
+            tables.repo_info,
+            ["repo_owner_username", "repo_name", "repo_language", "repo_description", "repo_topics"],
+        )
+        corpus_df = pd.DataFrame({"text": list(user_text) + list(repo_text)})
+        staged = StopWordsRemover("text__words", "text__filtered").transform(
+            Tokenizer("text", "text__words", remove_stop_words=False).transform(corpus_df)
+        )
+        return list(staged["text__filtered"])
+
     def word2vec(self):
         from albedo_tpu.models.word2vec import Word2Vec, Word2VecModel
 
         if "w2v" not in self._cache:
-            up, _, rp, _ = self.profiles()
-            corpus = [t.split() for t in rp["repo_text"]] + [
-                t.split() for t in up["user_recent_repo_descriptions"]
-            ]
             dim, iters = (16, 3) if not getattr(self.args, "tables", None) or self.small else (200, 30)
 
             def train():
+                # Corpus built lazily inside the closure: a cache hit on the
+                # trained model skips the full-table tokenization pass.
                 return Word2Vec(
                     dim=dim, min_count=3 if self.small else 10, max_iter=iters,
                     subsample=0.0,
-                ).fit_corpus(corpus)
+                ).fit_corpus(self.word2vec_corpus())
 
             arrays = load_or_create_pickle(
-                self.artifact_name(f"word2VecModel-{dim}-{iters}.pkl"),
+                self.artifact_name(f"word2VecModel-v2-{dim}-{iters}.pkl"),
                 lambda: train().to_arrays(),
             )
             self._cache["w2v"] = Word2VecModel(
@@ -370,7 +402,7 @@ def sync_index_job(args) -> None:
     lo, hi = (10, 290_000) if getattr(args, "tables", None) else (1, 10**9)
     backend = build_content_index(
         ctx.tables().repo_info, ctx.word2vec(), min_stars=lo, max_stars=hi,
-        artifact_name=ctx.artifact_name("contentIndex.npz"),
+        artifact_name=ctx.artifact_name("contentIndex-v2.npz"),
     )
     _report("sync_index", "indexed_repos", float(len(backend.item_ids)), t0)
 

@@ -62,17 +62,20 @@ def inverse_std_scales(fm: FeatureMatrix) -> Params:
     zero effect, mirroring MLlib's handling of zero-variance features.
     """
     n = max(1, fm.n_rows)
+    # MLlib's MultivariateOnlineSummarizer standardizes by the UNBIASED sample
+    # std (n-1 denominator); population→sample correction factor n/(n-1).
+    bessel = n / (n - 1) if n > 1 else 1.0
 
     def inv(std: np.ndarray) -> np.ndarray:
         return np.where(std > 0, 1.0 / np.maximum(std, 1e-12), 0.0).astype(np.float32)
 
     scales: Params = {"bias": np.float32(1.0)}
     d = fm.dense.astype(np.float64)
-    std = d.std(axis=0)
+    std = d.std(axis=0, ddof=1) if n > 1 else d.std(axis=0)
     scales["dense"] = inv(std)
     for f, size in fm.cat_sizes.items():
         p = np.bincount(fm.cat[f], minlength=size) / n
-        scales[f"cat:{f}"] = inv(np.sqrt(p * (1 - p)))
+        scales[f"cat:{f}"] = inv(np.sqrt(p * (1 - p) * bessel))
     for f, size in fm.bag_sizes.items():
         idx, val = fm.bag_idx[f], fm.bag_val[f]
         ok = idx >= 0
@@ -91,7 +94,7 @@ def inverse_std_scales(fm: FeatureMatrix) -> Params:
         s1 = np.bincount(col_of, weights=agg, minlength=size)
         s2 = np.bincount(col_of, weights=agg**2, minlength=size)
         mean = s1 / n
-        var = s2 / n - mean**2
+        var = (s2 / n - mean**2) * bessel
         scales[f"bag:{f}"] = inv(np.sqrt(np.maximum(var, 0)))
     return scales
 

@@ -73,7 +73,11 @@ def clean_location(location: str) -> str:
     """Normalize a location field to the city token (``cleanLocationUDF``):
     "City, Country" keeps the city, then lowercases, strips punctuation and a
     literal "city" word; ``__empty`` fallback."""
-    m = _RE_CITY_PAIR.match(location)
+    # Whole-string match: Scala's `val pattern(city, _) = location` extractor
+    # requires a full match; "San Francisco, CA, USA" raises MatchError there
+    # and the reference keeps the entire string, so fullmatch (not prefix
+    # match) is the parity-correct behavior.
+    m = _RE_CITY_PAIR.fullmatch(location)
     t = m.group(1) if m else location  # "San Francisco, CA" -> "San Francisco"
     t = t.lower()
     t = _RE_LOC_PUNCT.sub(" ", t)

@@ -1,0 +1,48 @@
+"""CLI job-context tests (L4 glue).
+
+The reference has no tests here; these pin the corpus-construction contract
+the advisor flagged: the Word2Vec training corpus must be tokenized with the
+SAME stages the ranker's inference pipeline uses (Tokenizer ->
+StopWordsRemover), over the reference's full user+repo text concat
+(``Word2VecCorpusBuilder.scala:47-69``).
+"""
+
+import argparse
+
+from albedo_tpu.builders.jobs import JobContext
+from albedo_tpu.features.text import ENGLISH_STOP_WORDS, Tokenizer
+
+
+def make_ctx(**over):
+    ns = argparse.Namespace(small=True, tables=None, now=1700000000.0)
+    for k, v in over.items():
+        setattr(ns, k, v)
+    return JobContext(ns)
+
+
+def test_word2vec_corpus_matches_inference_tokenization():
+    ctx = make_ctx()
+    corpus = ctx.word2vec_corpus()
+    tables = ctx.tables()
+    # One sentence per user plus one per repo.
+    assert len(corpus) == len(tables.user_info) + len(tables.repo_info)
+    tok = Tokenizer("x")
+    flat = [w for s in corpus for w in s]
+    assert flat, "corpus should not be empty"
+    for w in flat[:200]:
+        # Every corpus token must round-trip through the inference tokenizer
+        # unchanged (no punctuation-adjacent OOV) and not be a stop word.
+        assert tok.tokenize(w) == [w] or len(w) == 1  # CJK unigrams pass len-1
+        assert w not in ENGLISH_STOP_WORDS
+
+
+def test_word2vec_corpus_includes_user_and_repo_fields():
+    ctx = make_ctx()
+    corpus = {w for s in ctx.word2vec_corpus() for w in s}
+    tables = ctx.tables()
+    tok = Tokenizer("x")
+    # A user login and a repo language must surface in the vocab source.
+    login_tokens = [t for t in tok.tokenize(str(tables.user_info["user_login"].iloc[0])) if t]
+    lang_tokens = [t for t in tok.tokenize(str(tables.repo_info["repo_language"].iloc[0])) if t]
+    assert any(t in corpus for t in login_tokens)
+    assert any(t in corpus for t in lang_tokens)

@@ -65,7 +65,8 @@ def test_block_logits_match_dense_onehot(rng):
 def test_inverse_std_scales_match_dense_std(rng):
     fm = make_fm(rng, n=400)
     scales = inverse_std_scales(fm)
-    dense_std = fm.to_dense().std(axis=0)
+    # MLlib standardizes by the unbiased sample std (ddof=1).
+    dense_std = fm.to_dense().std(axis=0, ddof=1)
     flat = np.concatenate([scales["dense"], scales["cat:c"], scales["bag:b"]])
     expect = np.where(dense_std > 0, 1.0 / np.maximum(dense_std, 1e-12), 0.0)
     np.testing.assert_allclose(flat, expect, rtol=1e-3, atol=1e-5)

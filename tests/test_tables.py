@@ -132,6 +132,9 @@ def test_clean_location_takes_city():
     assert clean_location("Taipei, Taiwan") == "taipei"
     assert clean_location("New York City") == "new york"
     assert clean_location("") == "__empty"
+    # Scala's extractor needs a FULL match: multi-comma locations raise
+    # MatchError in the reference and keep the whole (cleaned) string.
+    assert clean_location("San Francisco, CA, USA") == "san francisco ca usa"
 
 
 def test_cjk_words_kept():
