@@ -19,6 +19,19 @@ import dataclasses
 import numpy as np
 
 
+def segment_positions(counts: np.ndarray) -> np.ndarray:
+    """0..count-1 position indices within each segment of a flat ragged array.
+
+    For ``counts = [3, 2]`` returns ``[0, 1, 2, 0, 1]``. The shared idiom for
+    walking concatenated per-user / per-sentence segments without a Python
+    loop (used by the negative balancer and the skip-gram pair builder).
+    """
+    counts = np.asarray(counts, dtype=np.int64)
+    total = int(counts.sum())
+    starts = np.cumsum(counts) - counts
+    return np.arange(total, dtype=np.int64) - np.repeat(starts, counts)
+
+
 @dataclasses.dataclass(frozen=True)
 class Bucket:
     """A fixed-shape batch of padded rows.

@@ -23,6 +23,7 @@ from albedo_tpu.features.assembler import FeatureMatrix
 from albedo_tpu.ops.sparse_linear import (
     Params,
     block_logits,
+    dense_center,
     feature_batch,
     fold_scales,
     init_params,
@@ -36,10 +37,15 @@ class LogisticRegressionModel:
     params: dict[str, Any]   # standardized-space coefficients
     scales: dict[str, Any]   # 1/std per feature
     train_loss: float
+    # Dense-block means subtracted before scaling (None = uncentered). See
+    # ops.sparse_linear.dense_center for why centering the dense block.
+    center: Any | None = None
 
     def decision_function(self, fm: FeatureMatrix) -> np.ndarray:
         batch = feature_batch(fm)
-        return np.asarray(block_logits(self.params, self.scales, batch))
+        return np.asarray(
+            block_logits(self.params, self.scales, batch, center=self.center)
+        )
 
     def predict_proba(self, fm: FeatureMatrix) -> np.ndarray:
         """P(label=1), the `probability[1]` the ranker sorts by
@@ -49,9 +55,13 @@ class LogisticRegressionModel:
     @property
     def coefficients(self) -> dict[str, np.ndarray]:
         """Raw-space coefficients (MLlib reports these after internal
-        standardization)."""
-        folded = fold_scales(self.params, self.scales)
-        return {k: np.asarray(v) for k, v in folded.items()}
+        standardization). The dense-centering shift folds into the bias:
+        ``b_raw = b_std - sum(beta_std * center / std)``."""
+        folded = {k: np.asarray(v) for k, v in fold_scales(self.params, self.scales).items()}
+        if self.center is not None:
+            shift = float(np.sum(folded["dense"] * np.asarray(self.center)))
+            folded["bias"] = np.float32(folded["bias"] - shift)
+        return folded
 
 
 @dataclasses.dataclass
@@ -87,15 +97,17 @@ class LogisticRegression:
 
         if self.standardization:
             scales = jax.tree.map(jnp.asarray, inverse_std_scales(fm))
+            center = jnp.asarray(dense_center(fm))
         else:
             scales = jax.tree.map(lambda p: jnp.ones_like(p), init_params(fm))
             scales["bias"] = jnp.float32(1.0)
+            center = None
 
         params = init_params(fm)
         reg = float(self.reg_param)
 
         def loss_fn(p):
-            return weighted_logloss(p, scales, batch, y, w, reg)
+            return weighted_logloss(p, scales, batch, y, w, reg, center=center)
 
         if self.solver == "lbfgs":
             params, loss = _run_lbfgs(loss_fn, params, self.max_iter, self.tol)
@@ -105,7 +117,8 @@ class LogisticRegression:
             raise ValueError(f"unknown solver {self.solver!r}")
 
         return LogisticRegressionModel(
-            params=params, scales=scales, train_loss=float(loss)
+            params=params, scales=scales, train_loss=float(loss),
+            center=None if center is None else np.asarray(center),
         )
 
 
@@ -126,7 +139,7 @@ def _run_lbfgs(loss_fn, params: Params, max_iter: int, tol: float):
         state = opt.init(params)
 
         def step(carry):
-            params, state, _prev, i, _bad = carry
+            params, state, prev, i, _bad, flat = carry
             value, grad = value_and_grad(params, state=state)
             updates, state = opt.update(
                 grad, state, params, value=value, grad=grad, value_fn=loss_fn
@@ -139,22 +152,24 @@ def _run_lbfgs(loss_fn, params: Params, max_iter: int, tol: float):
             kept = jax.tree.map(
                 lambda n, o: jnp.where(ok, n, o), new_params, params
             )
-            return kept, state, value, i + 1, ~ok
+            # Count CONSECUTIVE no-progress steps: in float32, L-BFGS can sit
+            # on an exact plateau for a step or two while the line search
+            # re-scales, then drop again — a single tiny delta is not
+            # convergence (observed: 2 flat steps then a 5e-4 drop).
+            plateau = jnp.abs(prev - value) <= tol * jnp.maximum(jnp.abs(value), 1e-12)
+            flat = jnp.where(plateau, flat + 1, 0)
+            return kept, state, value, i + 1, ~ok, flat
 
         def cont(carry):
-            params, state, prev, i, bad = carry
-            value = optax.tree.get(state, "value")
+            params, state, prev, i, bad, flat = carry
             grad = optax.tree.get(state, "grad")
             gnorm = optax.tree.norm(grad)
-            # Keep iterating while finite, under budget, and not converged.
-            return (
-                ~bad
-                & (i < max_iter)
-                & ((i < 2) | ((jnp.abs(prev - value) > tol * jnp.abs(value)) & (gnorm > tol)))
-            )
+            # Keep iterating while finite, under budget, and not converged
+            # (converged = 3 consecutive value plateaus, or vanished gradient).
+            return ~bad & (i < max_iter) & ((i < 2) | ((flat < 3) & (gnorm > tol)))
 
-        init = (params, state, jnp.inf, 0, jnp.bool_(False))
-        params, state, value, _, _ = jax.lax.while_loop(cont, step, init)
+        init = (params, state, jnp.inf, 0, jnp.bool_(False), 0)
+        params, state, value, _, _, _ = jax.lax.while_loop(cont, step, init)
         # Report the loss at the returned (finite) point, not the last
         # line-search value.
         return params, loss_fn(params)

@@ -17,7 +17,6 @@ is a ``lax.scan`` over minibatches with negatives drawn per step on device.
 from __future__ import annotations
 
 import dataclasses
-from collections import Counter
 
 import jax
 import jax.numpy as jnp
@@ -25,7 +24,46 @@ import numpy as np
 import optax
 import pandas as pd
 
+from albedo_tpu.datasets.ragged import segment_positions
 from albedo_tpu.features.pipeline import Transformer
+
+
+def skipgram_pairs(
+    ids: np.ndarray, lengths: np.ndarray, b: np.ndarray
+) -> tuple[np.ndarray, np.ndarray]:
+    """Vectorized skip-gram (center, context) pair construction.
+
+    ``ids``: all sentences' token ids concatenated, shape (T,).
+    ``lengths``: tokens per sentence, sum = T.
+    ``b``: per-position dynamic window radius (word2vec's b ~ uniform[1, w]).
+
+    Emits exactly the pairs the textbook per-position loop emits — for every
+    position i, every j in [i-b_i, i+b_i] within the same sentence, j != i —
+    but as 2·max(b) masked passes over the flat corpus instead of a Python
+    triple loop (the round-1 hot spot flagged in VERDICT.md). Pair order is
+    offset-major rather than position-major; training shuffles every epoch so
+    only the multiset matters (pinned by the parity test vs the naive loop).
+    """
+    ids = np.asarray(ids, dtype=np.int32)
+    lengths = np.asarray(lengths, dtype=np.int64)
+    b = np.asarray(b)
+    if ids.size == 0:
+        return np.zeros(0, np.int32), np.zeros(0, np.int32)
+    pos = segment_positions(lengths)
+    slen = np.repeat(lengths, lengths)
+    max_b = int(b.max()) if b.size else 0
+    centers_parts, contexts_parts = [], []
+    for d in range(-max_b, max_b + 1):
+        if d == 0:
+            continue
+        mask = (abs(d) <= b) & (pos + d >= 0) & (pos + d < slen)
+        idx = np.nonzero(mask)[0]
+        centers_parts.append(ids[idx])
+        contexts_parts.append(ids[idx + d])
+    return (
+        np.concatenate(centers_parts) if centers_parts else np.zeros(0, np.int32),
+        np.concatenate(contexts_parts) if contexts_parts else np.zeros(0, np.int32),
+    )
 
 
 @dataclasses.dataclass
@@ -103,14 +141,34 @@ class Word2Vec:
 
     def fit_corpus(self, sentences: list[list[str]]) -> Word2VecModel:
         rng = np.random.default_rng(self.seed)
-        counts = Counter(w for s in sentences for w in s)
-        vocab = [w for w, c in sorted(counts.items(), key=lambda kv: (-kv[1], kv[0])) if c >= self.min_count]
-        index = {w: i for i, w in enumerate(vocab)}
+        # Hash-factorize the flat corpus once (C speed) instead of a Python
+        # Counter + per-word dict lookups; vocab order stays (-count, word).
+        flat = [w for s in sentences for w in s]
+        lengths = np.fromiter((len(s) for s in sentences), dtype=np.int64, count=len(sentences))
+        if flat:
+            codes, uniques = pd.factorize(np.asarray(flat, dtype=object), sort=False)
+            uniq_counts = np.bincount(codes, minlength=len(uniques))
+        else:
+            codes = np.zeros(0, np.int64)
+            uniques, uniq_counts = np.asarray([], dtype=object), np.zeros(0, np.int64)
+        keep = uniq_counts >= self.min_count
+        # (-count, word) order over the UNIQUE words only — O(V log V), not
+        # corpus-sized like the old per-word Counter/dict path.
+        order = np.asarray(
+            sorted(np.nonzero(keep)[0], key=lambda i: (-uniq_counts[i], uniques[i])),
+            dtype=np.int64,
+        )
+        vocab = [str(w) for w in uniques[order]]
         v_size = len(vocab)
         if v_size == 0:
             return Word2VecModel([], np.zeros((0, self.dim), np.float32), self.input_col, self.output_col or f"{self.input_col}__w2v")
 
-        freq = np.array([counts[w] for w in vocab], dtype=np.float64)
+        # uniq code -> vocab id (or -1 for below-min_count words).
+        code_to_vocab = np.full(len(uniques), -1, dtype=np.int64)
+        code_to_vocab[order] = np.arange(v_size)
+        token_ids = code_to_vocab[codes]
+
+        freq = uniq_counts[order].astype(np.float64)
         total = freq.sum()
 
         # Frequent-word subsampling (word2vec's t-threshold keep probability).
@@ -120,27 +178,18 @@ class Word2Vec:
         else:
             keep_p = np.ones(v_size)
 
-        centers, contexts = [], []
-        for s in sentences:
-            ids = np.array([index[w] for w in s if w in index], dtype=np.int32)
-            if self.subsample > 0 and ids.size:
-                ids = ids[rng.random(ids.size) < keep_p[ids]]
-            n = ids.size
-            if n < 2:
-                continue
-            # Dynamic window shrink, as word2vec: b ~ uniform[1, window].
-            b = rng.integers(1, self.window + 1, size=n)
-            for i in range(n):
-                lo, hi = max(0, i - b[i]), min(n, i + b[i] + 1)
-                for j in range(lo, hi):
-                    if j != i:
-                        centers.append(ids[i])
-                        contexts.append(ids[j])
-        if not centers:
-            return Word2VecModel(vocab, np.zeros((v_size, self.dim), np.float32), self.input_col, self.output_col or f"{self.input_col}__w2v")
+        sent_id = np.repeat(np.arange(len(sentences), dtype=np.int64), lengths)
+        mask = token_ids >= 0
+        if self.subsample > 0:
+            mask &= rng.random(token_ids.size) < keep_p[np.maximum(token_ids, 0)]
+        ids_concat = token_ids[mask].astype(np.int32)
+        kept_lengths = np.bincount(sent_id[mask], minlength=len(sentences))
 
-        centers = np.asarray(centers, dtype=np.int32)
-        contexts = np.asarray(contexts, dtype=np.int32)
+        # Dynamic window shrink, as word2vec: b ~ uniform[1, window] per pos.
+        b = rng.integers(1, self.window + 1, size=ids_concat.size)
+        centers, contexts = skipgram_pairs(ids_concat, kept_lengths, b)
+        if centers.size == 0:
+            return Word2VecModel(vocab, np.zeros((v_size, self.dim), np.float32), self.input_col, self.output_col or f"{self.input_col}__w2v")
 
         # Negative-sampling distribution: unigram^0.75 (word2vec standard).
         noise_logits = jnp.asarray(0.75 * np.log(freq), dtype=jnp.float32)

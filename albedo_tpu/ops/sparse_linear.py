@@ -99,10 +99,31 @@ def inverse_std_scales(fm: FeatureMatrix) -> Params:
     return scales
 
 
-def block_logits(params: Params, scales: Params, batch: dict[str, jnp.ndarray]) -> jnp.ndarray:
+def dense_center(fm: FeatureMatrix) -> np.ndarray:
+    """Per-column means of the dense block (host side).
+
+    MLlib standardizes WITHOUT centering to preserve sparsity; that is fine in
+    its float64 aggregator, but in float32 a near-constant large-magnitude
+    column (e.g. document-embedding dims on homogeneous text) standardizes to
+    a huge constant offset that destroys the optimizer's conditioning. The
+    dense block is already dense, so centering it is free; the objective is
+    unchanged (the bias absorbs the shift) and the L2 penalty still applies to
+    the same standardized coefficients.
+    """
+    return fm.dense.astype(np.float64).mean(axis=0).astype(np.float32)
+
+
+def block_logits(
+    params: Params,
+    scales: Params,
+    batch: dict[str, jnp.ndarray],
+    center: jnp.ndarray | None = None,
+) -> jnp.ndarray:
     """(N,) logits; ``params`` are standardized-space coefficients and
-    ``scales`` the per-feature 1/std factors (use all-ones for raw space)."""
-    logits = params["bias"] + (batch["dense"] * scales["dense"]) @ params["dense"]
+    ``scales`` the per-feature 1/std factors (use all-ones for raw space).
+    ``center`` (optional) is subtracted from the dense block before scaling."""
+    dense = batch["dense"] if center is None else batch["dense"] - center
+    logits = params["bias"] + (dense * scales["dense"]) @ params["dense"]
     for key, arr in batch.items():
         if key.startswith("cat:"):
             f = key[len("cat:"):]
@@ -126,10 +147,16 @@ def weighted_logloss(
     labels: jnp.ndarray,
     weights: jnp.ndarray,
     reg: float,
+    center: jnp.ndarray | None = None,
 ) -> jnp.ndarray:
     """MLlib objective: (sum_i w_i * ce_i) / sum_i w_i + 0.5 * reg * ||beta_std||^2
     (bias unpenalized)."""
-    logits = block_logits(params, scales, batch)
+    logits = block_logits(params, scales, batch, center=center)
+    # Pre-clip to a finite range: if a line-search trial overshoots params so
+    # far the logits overflow to inf, the straight-through correction below
+    # would be inf - inf = nan. 1e6 is exactly representable in float32, so
+    # clipped + (35 - clipped) still evaluates to exactly 35.
+    logits = jnp.clip(logits, -1e6, 1e6)
     # Straight-through clip: cap the CE value so an L-BFGS line-search
     # overshoot can't produce inf - inf = nan, while keeping the gradient of
     # out-of-range (badly misclassified) samples alive.
