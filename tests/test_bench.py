@@ -1,0 +1,61 @@
+"""Bench harness units: the analytic ALS FLOP model and failure-path helpers.
+
+The bench contract (VERDICT round 1): probe the backend before touching the
+device, emit ONE structured JSON line on success or failure, and report MFU
+from an analytic FLOP model rather than claims in commit messages.
+"""
+
+import json
+import subprocess
+import sys
+
+import numpy as np
+
+import bench
+from albedo_tpu.datasets.synthetic import synthetic_stars
+
+
+def test_als_fit_flops_scaling():
+    m = synthetic_stars(n_users=300, n_items=200, mean_stars=10, seed=1)
+    one = bench.als_fit_flops(m, rank=8, iters=1, batch_size=64, max_entries=1 << 16)
+    ten = bench.als_fit_flops(m, rank=8, iters=10, batch_size=64, max_entries=1 << 16)
+    assert one["flops"] > 0
+    assert ten["flops"] == 10 * one["flops"]
+    assert ten["per_iter"] == one["per_iter"]
+    # Padding can only add entries.
+    assert one["padded_entries"] >= one["logical_nnz"]
+    # The Gramian term dominates and scales ~k^2: rank 16 >= ~3x rank 8.
+    big = bench.als_fit_flops(m, rank=16, iters=1, batch_size=64, max_entries=1 << 16)
+    assert big["flops"] > 3 * one["flops"]
+
+
+def test_peak_flops_lookup():
+    peak, src = bench.peak_flops_for("TPU v4", measured=1.0)
+    assert peak == 275e12 and "v4" in src
+    peak, src = bench.peak_flops_for("weird accelerator", measured=123.0)
+    assert peak == 123.0 and "measured" in src
+
+
+def test_stray_pid_scan_runs():
+    pids = bench.stray_accelerator_pids()
+    assert isinstance(pids, list)
+
+
+def test_bench_error_record_is_json(tmp_path):
+    """A broken backend must yield rc!=0 and ONE parseable JSON error line
+    (round-1 failure mode: bare stack trace, nothing parseable)."""
+    proc = subprocess.run(
+        [sys.executable, str(bench.__file__)],
+        capture_output=True, text=True, timeout=120,
+        env={
+            "PATH": "/usr/bin:/bin",
+            # Force the probe subprocess to die instantly.
+            "ALBEDO_BENCH_PLATFORM": "definitely_not_a_platform",
+            "ALBEDO_BENCH_PROBE_TIMEOUT": "30",
+        },
+    )
+    assert proc.returncode != 0
+    line = proc.stdout.strip().splitlines()[-1]
+    record = json.loads(line)
+    assert record["stage"] == "backend_probe"
+    assert record["value"] is None and record["error"]
