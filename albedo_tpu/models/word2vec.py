@@ -17,6 +17,7 @@ is a ``lax.scan`` over minibatches with negatives drawn per step on device.
 from __future__ import annotations
 
 import dataclasses
+from typing import Any
 
 import jax
 import jax.numpy as jnp
@@ -26,6 +27,7 @@ import pandas as pd
 
 from albedo_tpu.datasets.ragged import segment_positions
 from albedo_tpu.features.pipeline import Transformer
+from albedo_tpu.parallel.mesh import DATA_AXIS, replicated
 
 
 def skipgram_pairs(
@@ -138,6 +140,12 @@ class Word2Vec:
     seed: int = 42
     input_col: str = "words"
     output_col: str | None = None
+    # Optional jax.sharding.Mesh: shard the pair batch over the mesh's "data"
+    # axis with replicated embedding tables — the same layout as parallel.lr.
+    # XLA inserts the ICI psums for the replicated-table gradients, replacing
+    # MLlib Word2Vec's per-worker Hogwild tables + driver-side averaging
+    # (Word2VecCorpusBuilder.scala:74-83 runs it as a 39-minute cluster job).
+    mesh: Any | None = None
 
     def fit_corpus(self, sentences: list[list[str]]) -> Word2VecModel:
         rng = np.random.default_rng(self.seed)
@@ -195,6 +203,8 @@ class Word2Vec:
         noise_logits = jnp.asarray(0.75 * np.log(freq), dtype=jnp.float32)
 
         n_pairs = centers.shape[0]
+        # bs is NOT rounded for the mesh: the sharded fit must run the exact
+        # same minibatch boundaries as the single-device fit (parity contract).
         bs = min(self.batch_size, n_pairs)
         steps_per_epoch = n_pairs // bs
 
@@ -219,12 +229,28 @@ class Word2Vec:
             labels = jnp.zeros_like(logits).at[:, 0].set(1.0)
             return optax.sigmoid_binary_cross_entropy(logits, labels).sum(axis=1).mean()
 
+        # Shard the minibatch dim only when it divides evenly; otherwise leave
+        # layout to XLA (still correct, just less parallel) rather than change
+        # bs and silently diverge from the single-device math.
+        if self.mesh is not None and bs % int(self.mesh.shape[DATA_AXIS]) == 0:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            batch_sharding = NamedSharding(self.mesh, PartitionSpec(None, DATA_AXIS))
+        else:
+            batch_sharding = None
+
         @jax.jit
         def epoch(params, opt_state, key, centers_d, contexts_d):
             key, k_perm = jax.random.split(key)
             perm = jax.random.permutation(k_perm, centers_d.shape[0])
             c_sh = centers_d[perm][: steps_per_epoch * bs].reshape(steps_per_epoch, bs)
             o_sh = contexts_d[perm][: steps_per_epoch * bs].reshape(steps_per_epoch, bs)
+            if batch_sharding is not None:
+                # Minibatch dim sharded over "data": the gathers and the
+                # (B, 1+neg, d) logits einsum run data-parallel; the gradient
+                # of the replicated tables psums over ICI.
+                c_sh = jax.lax.with_sharding_constraint(c_sh, batch_sharding)
+                o_sh = jax.lax.with_sharding_constraint(o_sh, batch_sharding)
 
             def step(carry, batch):
                 p, s, k = carry
@@ -240,8 +266,18 @@ class Word2Vec:
             )
             return params, opt_state, key, losses.mean()
 
-        centers_d = jnp.asarray(centers)
-        contexts_d = jnp.asarray(contexts)
+        if self.mesh is not None:
+            # Pair pool replicated (it is small relative to HBM and keeps the
+            # global permutation identical to the single-device run); each
+            # step's minibatch is then sharded by the constraint above.
+            repl = replicated(self.mesh)
+            centers_d = jax.device_put(centers, repl)
+            contexts_d = jax.device_put(contexts, repl)
+            params = jax.device_put(params, repl)
+            opt_state = jax.device_put(opt_state, repl)
+        else:
+            centers_d = jnp.asarray(centers)
+            contexts_d = jnp.asarray(contexts)
         for _ in range(self.max_iter):
             params, opt_state, key, _loss = epoch(params, opt_state, key, centers_d, contexts_d)
 

@@ -187,7 +187,11 @@ def als_fit_flops(matrix, rank: int, iters: int, batch_size: int, max_entries: i
     return {
         "flops": per_iter * iters,
         "per_iter": per_iter,
+        # Each nnz is bucketed twice per iteration (once in the CSR user-solve
+        # buckets, once in the CSC item-solve buckets), so the honest padding
+        # overhead is padded_entries / logical_entries — both per-iteration.
         "padded_entries": padded_entries,
+        "logical_entries": 2 * int(matrix.nnz),
         "logical_nnz": int(matrix.nnz),
     }
 
@@ -303,6 +307,10 @@ def main() -> None:
                 "model_flops": round(flop["flops"]),
                 "flops_per_iter": round(flop["per_iter"]),
                 "padded_entries": flop["padded_entries"],
+                "logical_entries": flop["logical_entries"],
+                "padding_overhead": round(
+                    flop["padded_entries"] / max(1, flop["logical_entries"]), 2
+                ),
                 "logical_nnz": flop["logical_nnz"],
                 "measured_gemm_tflops": round(gemm_rate / 1e12, 2),
                 "achieved_tflops": round(flop["flops"] / train_s / 1e12, 4),

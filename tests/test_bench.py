@@ -22,8 +22,10 @@ def test_als_fit_flops_scaling():
     assert one["flops"] > 0
     assert ten["flops"] == 10 * one["flops"]
     assert ten["per_iter"] == one["per_iter"]
-    # Padding can only add entries.
-    assert one["padded_entries"] >= one["logical_nnz"]
+    # Padding can only add entries; each nnz is bucketed twice per iteration
+    # (CSR user-solve + CSC item-solve), hence logical_entries = 2*nnz.
+    assert one["logical_entries"] == 2 * one["logical_nnz"]
+    assert one["padded_entries"] >= one["logical_entries"]
     # The Gramian term dominates and scales ~k^2: rank 16 >= ~3x rank 8.
     big = bench.als_fit_flops(m, rank=16, iters=1, batch_size=64, max_entries=1 << 16)
     assert big["flops"] > 3 * one["flops"]

@@ -113,3 +113,26 @@ def test_sharded_topk_exclusion(rng):
     got = np.asarray(got_i)
     for u in range(10):
         assert excl[u, 0] not in got[u]
+
+
+def test_sharded_word2vec_matches_single_device(mesh8):
+    """Mesh-path W2V (pairs row-sharded, tables replicated, XLA-inserted
+    psums) must reproduce the single-device fit: same computation graph, only
+    the layout differs (VERDICT round 1 next-step #4)."""
+    from albedo_tpu.models.word2vec import Word2Vec
+
+    rng = np.random.default_rng(4)
+    words = [f"w{i}" for i in range(30)]
+    sentences = [
+        [words[j] for j in rng.integers(0, 30, size=rng.integers(3, 9))]
+        for _ in range(300)
+    ]
+    kw = dict(dim=8, window=3, min_count=1, max_iter=4, batch_size=64,
+              subsample=0.0, seed=9)
+    single = Word2Vec(**kw).fit_corpus(sentences)
+    sharded = Word2Vec(**kw, mesh=mesh8).fit_corpus(sentences)
+    assert single.vocab == sharded.vocab
+    # Identical math modulo reduction order: tight-but-not-bitwise tolerance.
+    np.testing.assert_allclose(sharded.vectors, single.vectors, rtol=5e-3, atol=5e-4)
+    # And the embeddings must be non-trivial (training actually happened).
+    assert np.linalg.norm(single.vectors, axis=1).mean() > 0.01
