@@ -21,9 +21,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from albedo_tpu.datasets.ragged import bucket_rows, device_bucket
+from albedo_tpu.datasets.ragged import bucket_rows, device_bucket, group_buckets
 from albedo_tpu.datasets.star_matrix import StarMatrix
-from albedo_tpu.ops.als import als_half_sweep
+from albedo_tpu.ops.als import als_fit_fused
 from albedo_tpu.ops.topk import topk_scores
 
 
@@ -109,32 +109,45 @@ class ImplicitALS:
             max_len=self.max_len,
         )
 
-        sweep = None
-        if self.mesh is not None:
-            from albedo_tpu.parallel.als import ShardedALSSweep
-
-            sweep = ShardedALSSweep(self.mesh)
-            user_buckets = sweep.prepare(user_buckets)
-            item_buckets = sweep.prepare(item_buckets)
-        else:
-            # Upload every bucket once; the sweeps reuse the device copies
-            # across all max_iter iterations instead of re-transferring.
-            user_buckets = [device_bucket(b) for b in user_buckets]
-            item_buckets = [device_bucket(b) for b in item_buckets]
-
         key = jax.random.PRNGKey(self.seed)
         ukey, ikey = jax.random.split(key)
         scale = 1.0 / np.sqrt(self.rank)
         user_f = jax.random.normal(ukey, (matrix.n_users, self.rank), jnp.float32) * scale
         item_f = jax.random.normal(ikey, (matrix.n_items, self.rank), jnp.float32) * scale
 
-        half = sweep.half_sweep if sweep is not None else als_half_sweep
-        for it in range(self.max_iter):
-            # MLlib order: item factors first (from user factors), then users.
-            item_f = half(user_f, item_f, item_buckets, self.reg_param, self.alpha)
-            user_f = half(item_f, user_f, user_buckets, self.reg_param, self.alpha)
-            if callback is not None:
-                callback(it, np.asarray(user_f), np.asarray(item_f))
+        if self.mesh is not None:
+            from albedo_tpu.parallel.als import ShardedALSSweep
+
+            sweep = ShardedALSSweep(self.mesh)
+            user_buckets = sweep.prepare(user_buckets)
+            item_buckets = sweep.prepare(item_buckets)
+            for it in range(self.max_iter):
+                # MLlib order: item factors first (from user factors), then users.
+                item_f = sweep.half_sweep(user_f, item_f, item_buckets, self.reg_param, self.alpha)
+                user_f = sweep.half_sweep(item_f, user_f, user_buckets, self.reg_param, self.alpha)
+                if callback is not None:
+                    callback(it, np.asarray(user_f), np.asarray(item_f))
+        else:
+            # Stack same-shape buckets and upload once; the whole max_iter loop
+            # then runs as a single fused dispatch (``ops.als.als_fit_fused``).
+            ug = [device_bucket(g) for g in group_buckets(user_buckets)]
+            ig = [device_bucket(g) for g in group_buckets(item_buckets)]
+            ug = [(g.row_ids, g.idx, g.val, g.mask) for g in ug]
+            ig = [(g.row_ids, g.idx, g.val, g.mask) for g in ig]
+            reg = jnp.float32(self.reg_param)
+            alpha = jnp.float32(self.alpha)
+            if callback is None:
+                user_f, item_f = als_fit_fused(
+                    user_f, item_f, ug, ig, reg, alpha, jnp.int32(self.max_iter)
+                )
+            else:
+                # One fused dispatch per iteration (same executable: n_iter is
+                # traced), surfacing factors to the host for the callback.
+                for it in range(self.max_iter):
+                    user_f, item_f = als_fit_fused(
+                        user_f, item_f, ug, ig, reg, alpha, jnp.int32(1)
+                    )
+                    callback(it, np.asarray(user_f), np.asarray(item_f))
 
         return ALSModel(
             user_factors=np.asarray(user_f),

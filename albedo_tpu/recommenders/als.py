@@ -16,6 +16,7 @@ from __future__ import annotations
 import numpy as np
 import pandas as pd
 
+from albedo_tpu.datasets.ragged import padded_rows
 from albedo_tpu.datasets.star_matrix import StarMatrix
 from albedo_tpu.models.als import ALSModel
 from albedo_tpu.recommenders.base import Recommender
@@ -51,11 +52,7 @@ class ALSRecommender(Recommender):
         excl = None
         if self.exclude_seen:
             indptr, cols, _ = self.matrix.csr()
-            width = max(1, int(np.diff(indptr)[rows].max()))
-            excl = np.full((rows.size, width), -1, dtype=np.int32)
-            for r, u in enumerate(rows):
-                lo, hi = indptr[u], indptr[u + 1]
-                excl[r, : hi - lo] = cols[lo:hi]
+            excl = padded_rows(indptr, cols, rows)
 
         if self.mesh is not None:
             from albedo_tpu.parallel.topk import sharded_topk_scores

@@ -196,20 +196,148 @@ def als_fit_flops(matrix, rank: int, iters: int, batch_size: int, max_entries: i
     }
 
 
-def measured_gemm_flops_per_s(jnp, jax) -> float:
-    """Achievable matmul roofline on this chip: one large f32 GEMM at JAX's
-    default (bf16-pass) precision, best of 3 after a compile warmup."""
-    n = 4096
-    a = jnp.ones((n, n), jnp.float32)
-    b = jnp.ones((n, n), jnp.float32)
-    f = jax.jit(lambda x, y: x @ y)
-    f(a, b).block_until_ready()
+GEMM_N = int(os.environ.get("ALBEDO_BENCH_GEMM_N", "4096"))
+GEMM_CHAIN = int(os.environ.get("ALBEDO_BENCH_GEMM_CHAIN", "32"))
+
+
+def measured_gemm_flops_per_s(jnp, jax, dtype, n: int = GEMM_N, chain: int = GEMM_CHAIN) -> float:
+    """Achievable matmul roofline on this chip: ``chain`` dependent n x n GEMMs
+    inside ONE jitted scan, so per-dispatch latency is amortized away.
+
+    The round-2 bench timed a single GEMM per dispatch and reported 0.95 TF/s
+    on a v5e — that number was the host<->device round-trip (a 4096^3 GEMM takes
+    <1 ms at real v5e rates, far below the tunnel RTT), not the chip. Chaining
+    makes each step depend on the previous, so XLA cannot elide or overlap the
+    work, and one dispatch covers ``chain`` GEMMs.
+    """
+    rng = np.random.default_rng(0)
+    # Scale keeps the chained product's spectral norm < 1 (values decay toward
+    # zero instead of overflowing; matmul cost is value-independent).
+    a = jnp.asarray(rng.standard_normal((n, n)), dtype)
+    b = jnp.asarray(rng.standard_normal((n, n)) * (0.5 / np.sqrt(n)), dtype)
+
+    @jax.jit
+    def run(x, y):
+        def step(c, _):
+            return y @ c, None
+        out, _ = jax.lax.scan(step, x, length=chain)
+        return out
+
+    run(a, b).block_until_ready()
     best = float("inf")
     for _ in range(3):
         t0 = time.perf_counter()
-        f(a, b).block_until_ready()
+        run(a, b).block_until_ready()
         best = min(best, time.perf_counter() - t0)
-    return 2.0 * n**3 / best
+    return 2.0 * n**3 * chain / best
+
+
+def measured_dispatch_latency_s(jnp, jax) -> float:
+    """Round-trip time of one trivial jitted op — the per-dispatch cost that
+    dominated the unfused sweep (and the old single-GEMM roofline) on a
+    tunneled TPU backend."""
+    f = jax.jit(lambda x: x + 1.0)
+    x = jnp.float32(0.0)
+    f(x).block_until_ready()
+    best = float("inf")
+    for _ in range(5):
+        t0 = time.perf_counter()
+        f(x).block_until_ready()
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def phase_breakdown(jax, jnp, train, als, repeats: int = 4) -> dict:
+    """Amortized per-phase seconds for one full ALS iteration (both half
+    sweeps) on the real bucket groups.
+
+    Levels build up the sweep one phase at a time — gather only; + Gramian
+    einsum; + Cholesky solve; the full fused iteration — all inside a
+    ``fori_loop`` of ``repeats`` so dispatch cost amortizes; deltas between
+    levels attribute time to each phase. A tiny accumulator-dependent
+    perturbation of the source factors defeats XLA's loop-invariant hoisting.
+    """
+    from albedo_tpu.datasets.ragged import bucket_rows, device_bucket, group_buckets
+    from albedo_tpu.ops.als import als_fit_fused, bucket_solve_body
+
+    sides = []
+    for csx in (train.csr(), train.csc()):
+        bs = bucket_rows(
+            *csx, batch_size=als.batch_size,
+            max_entries=als.max_entries, max_len=als.max_len,
+        )
+        sides.append([device_bucket(g) for g in group_buckets(bs)])
+    user_groups, item_groups = sides
+
+    rng = np.random.default_rng(0)
+    scale = 1.0 / np.sqrt(als.rank)
+    uf0 = (rng.standard_normal((train.n_users, als.rank)) * scale).astype(np.float32)
+    vf0 = (rng.standard_normal((train.n_items, als.rank)) * scale).astype(np.float32)
+    reg = jnp.float32(als.reg_param)
+    alpha = jnp.float32(als.alpha)
+
+    def make_level(level):
+        def half(source, groups, acc):
+            # acc-dependent perturbation: keeps the body loop-variant.
+            src = source + acc * 1e-30
+            yty = src.T @ src
+
+            def body(a, g):
+                row_ids, idx, val, mask = g
+                if level == 0:
+                    a = a + src[idx].mean()
+                elif level == 1:
+                    gathered = src[idx]
+                    corr = jnp.einsum("blk,bl,blm->bkm", gathered, alpha * val, gathered)
+                    a = a + corr.mean() + yty.mean()
+                else:
+                    solved = bucket_solve_body(src, yty, idx, val, mask, reg, alpha)
+                    a = a + solved.mean()
+                return a, None
+
+            for g in groups:
+                acc, _ = jax.lax.scan(body, acc, (g.row_ids, g.idx, g.val, g.mask))
+            return acc
+
+        @jax.jit
+        def run(uf, vf):
+            def it(_, acc):
+                acc = half(uf, item_groups, acc)
+                acc = half(vf, user_groups, acc)
+                return acc
+            return jax.lax.fori_loop(0, repeats, it, jnp.float32(0.0))
+
+        return run
+
+    out = {}
+    uf, vf = jnp.asarray(uf0), jnp.asarray(vf0)
+    levels = []
+    for lvl in range(3):
+        run = make_level(lvl)
+        run(uf, vf).block_until_ready()  # compile
+        t0 = time.perf_counter()
+        run(uf, vf).block_until_ready()
+        levels.append((time.perf_counter() - t0) / repeats)
+
+    ug = [(g.row_ids, g.idx, g.val, g.mask) for g in user_groups]
+    ig = [(g.row_ids, g.idx, g.val, g.mask) for g in item_groups]
+    n_it = jnp.int32(repeats)
+    # als_fit_fused donates its factor args: hand it fresh copies per call.
+    jax.block_until_ready(
+        als_fit_fused(jnp.asarray(uf0), jnp.asarray(vf0), ug, ig, reg, alpha, n_it)
+    )
+    t0 = time.perf_counter()
+    jax.block_until_ready(
+        als_fit_fused(jnp.asarray(uf0), jnp.asarray(vf0), ug, ig, reg, alpha, n_it)
+    )
+    full = (time.perf_counter() - t0) / repeats
+
+    out["gather_s"] = round(levels[0], 5)
+    out["gramian_einsum_s"] = round(max(0.0, levels[1] - levels[0]), 5)
+    out["cholesky_solve_s"] = round(max(0.0, levels[2] - levels[1]), 5)
+    out["scatter_s"] = round(max(0.0, full - levels[2]), 5)
+    out["full_iteration_s"] = round(full, 5)
+    return out
 
 
 def peak_flops_for(device_kind: str, measured: float) -> tuple[float, str]:
@@ -233,6 +361,7 @@ def main() -> None:
         import jax.numpy as jnp
 
         from albedo_tpu.datasets import random_split_by_user, sample_test_users
+        from albedo_tpu.datasets.ragged import padded_rows
         from albedo_tpu.datasets.synthetic import synthetic_stars
         from albedo_tpu.evaluators import RankingEvaluator, UserItems, user_actual_items
         from albedo_tpu.models.als import ImplicitALS
@@ -270,19 +399,20 @@ def main() -> None:
             train, rank=als.rank, iters=als.max_iter,
             batch_size=als.batch_size, max_entries=als.max_entries,
         )
-        gemm_rate = measured_gemm_flops_per_s(jnp, jax)
-        peak, peak_source = peak_flops_for(info.get("device_kind", ""), gemm_rate)
+        gemm_f32 = measured_gemm_flops_per_s(jnp, jax, jnp.float32)
+        gemm_bf16 = measured_gemm_flops_per_s(jnp, jax, jnp.bfloat16)
+        dispatch_s = measured_dispatch_latency_s(jnp, jax)
+        peak, peak_source = peak_flops_for(info.get("device_kind", ""), gemm_bf16)
         mfu = flop["flops"] / (train_s * peak)
+        phases = {}
+        if os.environ.get("ALBEDO_BENCH_BREAKDOWN", "1") != "0":
+            phases = phase_breakdown(jax, jnp, train, als)
 
         # Quality gate: NDCG@30 on held-out stars, training positives excluded,
         # the ALSRecommenderBuilder eval protocol (:75-104).
         users = sample_test_users(train, n=500, seed=42)
         indptr, cols, _ = train.csr()
-        width = int(np.diff(indptr)[users].max())
-        excl = np.full((len(users), width), -1, dtype=np.int32)
-        for r, u in enumerate(users):
-            lo, hi = indptr[u], indptr[u + 1]
-            excl[r, : hi - lo] = cols[lo:hi]
+        excl = padded_rows(indptr, cols, users)
         _, idx = model.recommend(users, k=30, exclude_idx=excl)
         ndcg = RankingEvaluator(metric_name="ndcg@k", k=30).evaluate(
             UserItems(users=users, items=idx.astype(np.int32)),
@@ -312,8 +442,14 @@ def main() -> None:
                     flop["padded_entries"] / max(1, flop["logical_entries"]), 2
                 ),
                 "logical_nnz": flop["logical_nnz"],
-                "measured_gemm_tflops": round(gemm_rate / 1e12, 2),
+                "measured_gemm_tflops": round(gemm_f32 / 1e12, 2),
+                "measured_gemm_tflops_bf16": round(gemm_bf16 / 1e12, 2),
+                "dispatch_latency_ms": round(dispatch_s * 1e3, 2),
                 "achieved_tflops": round(flop["flops"] / train_s / 1e12, 4),
+                "vs_measured_roofline": round(
+                    flop["flops"] / train_s / max(gemm_f32, 1.0), 4
+                ),
+                "phase_breakdown": phases,
             }
         ),
         flush=True,
