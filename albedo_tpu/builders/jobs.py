@@ -37,7 +37,14 @@ TOP_K = 30
 class JobContext:
     """Shared lazily-built artifacts for one CLI invocation."""
 
-    def __init__(self, args: argparse.Namespace):
+    def __init__(
+        self,
+        args: argparse.Namespace,
+        tables: RawTables | None = None,
+        tag: str | None = None,
+    ):
+        """``tables``/``tag`` inject a pre-built dataset (and its artifact
+        identity) without going through ``--tables`` — used by the bench."""
         self.args = args
         self.small = bool(getattr(args, "small", False))
         now = getattr(args, "now", None)
@@ -48,8 +55,12 @@ class JobContext:
         from albedo_tpu.settings import md5
 
         source = str(getattr(args, "tables", None) or f"synthetic-{self.small}")
-        self.tag = md5(source)[:10]
+        self.tag = tag if tag is not None else md5(source)[:10]
         self._cache: dict[str, object] = {}
+        if tables is not None:
+            if tag is None:
+                raise ValueError("injected tables require an explicit tag")
+            self._cache["tables"] = tables
 
     def artifact_name(self, base: str) -> str:
         return f"{self.tag}-{base}"

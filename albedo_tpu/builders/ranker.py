@@ -143,6 +143,7 @@ class RankerResult:
     model: RankerModel
     auc: float
     ndcg: float | None
+    n_rows: int = 0  # balanced (positive + sampled-negative) training rows
 
 
 def reduce_starring(starring: pd.DataFrame, max_count: int) -> pd.DataFrame:
@@ -215,61 +216,79 @@ def train_ranker(
     config: RankerConfig = RankerConfig(),
     recommenders: Sequence[Recommender] | None = None,
     eval_actual: "UserItems | None" = None,
+    timer=None,
 ) -> RankerResult:
-    """End-to-end ranker training + evaluation (SURVEY.md §3.2)."""
+    """End-to-end ranker training + evaluation (SURVEY.md §3.2).
+
+    ``timer`` (``albedo_tpu.utils.profiling.Timer``) if given records per-stage
+    wall-clock — the bench's stage breakdown vs the reference's 1h35m job
+    (``Makefile:209``).
+    """
     rng = np.random.default_rng(config.seed)
+    if timer is None:
+        from albedo_tpu.utils.profiling import Timer
+
+        timer = Timer()
 
     # 1-2. Reduce + negative-sample + profile joins. The reference featurizes
     # the positives first to FIT the pipeline (:237-240), then transforms the
     # balanced set; vocab-fitting on positives only is preserved here.
-    reduced = reduce_starring(tables.starring, config.max_starred_repos_count)
-    profile_starring = reduced.merge(user_profile, on="user_id").merge(
-        repo_profile, on="repo_id"
-    )
+    with timer.section("reduce_join"):
+        reduced = reduce_starring(tables.starring, config.max_starred_repos_count)
+        profile_starring = reduced.merge(user_profile, on="user_id").merge(
+            repo_profile, on="repo_id"
+        )
 
-    als_scorer = ALSScorer(als_model, matrix)
-    pipeline, spec = build_feature_pipeline(
-        als_scorer, user_cols, repo_cols, w2v, config.min_df
-    )
-    feature_model = pipeline.fit(profile_starring)
+    with timer.section("pipeline_fit"):
+        als_scorer = ALSScorer(als_model, matrix)
+        pipeline, spec = build_feature_pipeline(
+            als_scorer, user_cols, repo_cols, w2v, config.min_df
+        )
+        feature_model = pipeline.fit(profile_starring)
 
     # 4. Negative balancing on the reduced starring, then profile join +
     # featurize (:244-291).
-    pop = popular_repos(
-        tables.repo_info, config.popular_min_stars, config.popular_max_stars
-    )
-    balancer = NegativeBalancer(
-        pop["repo_id"].to_numpy(np.int64),
-        negative_positive_ratio=config.negative_positive_ratio,
-    )
-    balanced = balancer.transform(reduced)
-    profile_balanced = balanced.merge(user_profile, on="user_id").merge(
-        repo_profile, on="repo_id"
-    )
-    featured = feature_model.transform(profile_balanced)
+    with timer.section("negative_balance"):
+        pop = popular_repos(
+            tables.repo_info, config.popular_min_stars, config.popular_max_stars
+        )
+        balancer = NegativeBalancer(
+            pop["repo_id"].to_numpy(np.int64),
+            negative_positive_ratio=config.negative_positive_ratio,
+        )
+        balanced = balancer.transform(reduced)
+        profile_balanced = balanced.merge(user_profile, on="user_id").merge(
+            repo_profile, on="repo_id"
+        )
+    with timer.section("featurize"):
+        featured = feature_model.transform(profile_balanced)
 
-    assembler = FeatureAssembler(**spec, max_bag_pad=config.max_bag_pad).fit(featured)
+    with timer.section("assembler_fit"):
+        assembler = FeatureAssembler(**spec, max_bag_pad=config.max_bag_pad).fit(featured)
 
     # 5. Split, weigh, train LR (:297-350).
-    is_test = rng.random(len(featured)) < config.test_ratio
-    train_df = featured[~is_test].reset_index(drop=True)
-    test_df = featured[is_test].reset_index(drop=True)
+    with timer.section("weigh_assemble"):
+        is_test = rng.random(len(featured)) < config.test_ratio
+        train_df = featured[~is_test].reset_index(drop=True)
+        test_df = featured[is_test].reset_index(drop=True)
 
-    weigher = InstanceWeigher(now=now)
-    train_w = weigher.transform(train_df)
-    fm_train = assembler.assemble(train_w)
-    lr = LogisticRegression(max_iter=config.lr_max_iter, reg_param=config.lr_reg_param)
-    lr_model = lr.fit(
-        fm_train,
-        train_w["starring"].to_numpy(np.float32),
-        sample_weight=train_w[config.weight_col].to_numpy(np.float32),
-    )
+        weigher = InstanceWeigher(now=now)
+        train_w = weigher.transform(train_df)
+        fm_train = assembler.assemble(train_w)
+    with timer.section("lr_fit"):
+        lr = LogisticRegression(max_iter=config.lr_max_iter, reg_param=config.lr_reg_param)
+        lr_model = lr.fit(
+            fm_train,
+            train_w["starring"].to_numpy(np.float32),
+            sample_weight=train_w[config.weight_col].to_numpy(np.float32),
+        )
 
     # 6a. AUC on the held-out split (:354-364).
-    fm_test = assembler.assemble(test_df)
-    auc = area_under_roc(
-        test_df["starring"].to_numpy(np.float32), lr_model.predict_proba(fm_test)
-    )
+    with timer.section("auc_eval"):
+        fm_test = assembler.assemble(test_df)
+        auc = area_under_roc(
+            test_df["starring"].to_numpy(np.float32), lr_model.predict_proba(fm_test)
+        )
 
     model = RankerModel(
         feature_pipeline=feature_model,
@@ -283,23 +302,24 @@ def train_ranker(
     # 6b. Candidate fusion + re-rank + NDCG@30 (:368-444).
     ndcg = None
     if recommenders:
-        test_users = test_df["user_id"].unique()
-        take = min(config.n_test_users, len(test_users))
-        sampled = rng.choice(test_users, size=take, replace=False)
-        candidates = fuse_candidates(
-            [r.recommend_for_users(sampled) for r in recommenders]
-        )
-        scored = model.score(candidates)
-        dense_users = matrix.users_of(scored["user_id"].to_numpy(np.int64))
-        predicted = user_items_from_pairs(
-            dense_users,
-            matrix.items_of(scored["repo_id"].to_numpy(np.int64)),
-            order_key=scored["probability"].to_numpy(np.float64),
-            k=config.top_k,
-        )
-        actual = eval_actual if eval_actual is not None else user_actual_items(matrix, k=config.top_k)
-        ndcg = RankingEvaluator(metric_name="ndcg@k", k=config.top_k).evaluate(
-            predicted, actual
-        )
+        with timer.section("fuse_rerank_ndcg"):
+            test_users = test_df["user_id"].unique()
+            take = min(config.n_test_users, len(test_users))
+            sampled = rng.choice(test_users, size=take, replace=False)
+            candidates = fuse_candidates(
+                [r.recommend_for_users(sampled) for r in recommenders]
+            )
+            scored = model.score(candidates)
+            dense_users = matrix.users_of(scored["user_id"].to_numpy(np.int64))
+            predicted = user_items_from_pairs(
+                dense_users,
+                matrix.items_of(scored["repo_id"].to_numpy(np.int64)),
+                order_key=scored["probability"].to_numpy(np.float64),
+                k=config.top_k,
+            )
+            actual = eval_actual if eval_actual is not None else user_actual_items(matrix, k=config.top_k)
+            ndcg = RankingEvaluator(metric_name="ndcg@k", k=config.top_k).evaluate(
+                predicted, actual
+            )
 
-    return RankerResult(model=model, auc=float(auc), ndcg=ndcg)
+    return RankerResult(model=model, auc=float(auc), ndcg=ndcg, n_rows=len(train_df))

@@ -60,6 +60,30 @@ class FuncTransformer(Transformer):
         return out
 
 
+def memo_map(values, func: Callable[[Any], T], key: Callable[[Any], Any] | None = None) -> list[T]:
+    """Apply ``func`` once per distinct value and map results back by key.
+
+    The ranker's joined row sets repeat each user/repo document once per
+    (user, repo) pair, so per-row tokenize/filter/embed work is ~100x
+    redundant; memoizing by document collapses it to once per distinct text.
+    Repeated rows share the SAME result object — downstream stages treat
+    columns as read-only (Spark DataFrame semantics), so aliasing is safe.
+
+    ``key`` maps unhashable values (token lists) to a hashable key (tuple).
+    """
+    cache: dict = {}
+    out = []
+    sentinel = object()
+    for v in values:
+        k = v if key is None else key(v)
+        got = cache.get(k, sentinel)
+        if got is sentinel:
+            got = func(v)
+            cache[k] = got
+        out.append(got)
+    return out
+
+
 class PipelineModel(Transformer):
     """A fitted pipeline: transformers applied in sequence."""
 

@@ -26,7 +26,7 @@ import numpy as np
 import pandas as pd
 
 from albedo_tpu.features.assembler import set_vocab_size
-from albedo_tpu.features.pipeline import Estimator, Transformer
+from albedo_tpu.features.pipeline import Estimator, Transformer, memo_map
 
 _LANGUAGE_TOKENS = {"c", "r", "c++", "c#", "f#"}
 _RE_CJK_CHAR = re.compile("[぀-ゟ゠-ヿ㄀-ㄯ豈-﫿一-鿿]")
@@ -101,7 +101,9 @@ class Tokenizer(Transformer):
     def transform(self, df: pd.DataFrame) -> pd.DataFrame:
         self.require_cols(df, [self.input_col])
         out = df.copy()
-        out[self.output_col] = [self.tokenize(t or "") for t in df[self.input_col]]
+        out[self.output_col] = memo_map(
+            df[self.input_col], lambda t: self.tokenize(t or "")
+        )
         return out
 
 
@@ -123,9 +125,11 @@ class StopWordsRemover(Transformer):
     def transform(self, df: pd.DataFrame) -> pd.DataFrame:
         self.require_cols(df, [self.input_col])
         out = df.copy()
-        out[self.output_col] = [
-            [w for w in words if w not in self.stop_words] for words in df[self.input_col]
-        ]
+        out[self.output_col] = memo_map(
+            df[self.input_col],
+            lambda words: [w for w in words if w not in self.stop_words],
+            key=tuple,
+        )
         return out
 
 
@@ -144,20 +148,20 @@ class CountVectorizerModel(Transformer):
     def vocab_size(self) -> int:
         return len(self.vocab)
 
+    def _bag(self, words) -> tuple[np.ndarray, np.ndarray]:
+        counts = Counter(self._index[w] for w in words if w in self._index)
+        idx = np.fromiter(counts.keys(), dtype=np.int32, count=len(counts))
+        val = np.fromiter(counts.values(), dtype=np.float32, count=len(counts))
+        if self.binary:
+            val = np.ones_like(val)
+        return idx, val
+
     def transform(self, df: pd.DataFrame) -> pd.DataFrame:
         self.require_cols(df, [self.input_col])
-        idx_col, val_col = [], []
-        for words in df[self.input_col]:
-            counts = Counter(self._index[w] for w in words if w in self._index)
-            idx = np.fromiter(counts.keys(), dtype=np.int32, count=len(counts))
-            val = np.fromiter(counts.values(), dtype=np.float32, count=len(counts))
-            if self.binary:
-                val = np.ones_like(val)
-            idx_col.append(idx)
-            val_col.append(val)
+        bags = memo_map(df[self.input_col], self._bag, key=tuple)
         out = df.copy()
-        out[f"{self.output_col}__bag_idx"] = idx_col
-        out[f"{self.output_col}__bag_val"] = val_col
+        out[f"{self.output_col}__bag_idx"] = [b[0] for b in bags]
+        out[f"{self.output_col}__bag_val"] = [b[1] for b in bags]
         set_vocab_size(out, self.output_col, self.vocab_size)
         return out
 

@@ -90,24 +90,36 @@ class ImplicitALS:
     # "data" axis (albedo_tpu.parallel.als) instead of single-device sweeps.
     mesh: Any | None = None
 
+    def _host_buckets(self, matrix: StarMatrix) -> tuple[list, list]:
+        """(user, item) bucket lists — the exact layouts ``fit`` trains on."""
+        return tuple(  # type: ignore[return-value]
+            bucket_rows(
+                *csx,
+                batch_size=self.batch_size,
+                max_entries=self.max_entries,
+                max_len=self.max_len,
+            )
+            for csx in (matrix.csr(), matrix.csc())
+        )
+
+    def device_groups(self, matrix: StarMatrix) -> tuple[list[tuple], list[tuple]]:
+        """Stacked same-shape groups on device, as ``als_fit_fused`` consumes
+        them — shared by ``fit`` and the bench's phase breakdown so both always
+        measure the same shapes."""
+        user_buckets, item_buckets = self._host_buckets(matrix)
+        ug = [device_bucket(g) for g in group_buckets(user_buckets)]
+        ig = [device_bucket(g) for g in group_buckets(item_buckets)]
+        return (
+            [(g.row_ids, g.idx, g.val, g.mask) for g in ug],
+            [(g.row_ids, g.idx, g.val, g.mask) for g in ig],
+        )
+
     def fit(self, matrix: StarMatrix, callback: Any | None = None) -> ALSModel:
         """Train factors on the default backend, or sharded over ``self.mesh``.
 
         ``callback(iteration, user_factors, item_factors)`` if given is invoked
         after each full sweep (host arrays; for monitoring/tests).
         """
-        user_buckets = bucket_rows(
-            *matrix.csr(),
-            batch_size=self.batch_size,
-            max_entries=self.max_entries,
-            max_len=self.max_len,
-        )
-        item_buckets = bucket_rows(
-            *matrix.csc(),
-            batch_size=self.batch_size,
-            max_entries=self.max_entries,
-            max_len=self.max_len,
-        )
 
         key = jax.random.PRNGKey(self.seed)
         ukey, ikey = jax.random.split(key)
@@ -118,6 +130,7 @@ class ImplicitALS:
         if self.mesh is not None:
             from albedo_tpu.parallel.als import ShardedALSSweep
 
+            user_buckets, item_buckets = self._host_buckets(matrix)
             sweep = ShardedALSSweep(self.mesh)
             user_buckets = sweep.prepare(user_buckets)
             item_buckets = sweep.prepare(item_buckets)
@@ -130,10 +143,7 @@ class ImplicitALS:
         else:
             # Stack same-shape buckets and upload once; the whole max_iter loop
             # then runs as a single fused dispatch (``ops.als.als_fit_fused``).
-            ug = [device_bucket(g) for g in group_buckets(user_buckets)]
-            ig = [device_bucket(g) for g in group_buckets(item_buckets)]
-            ug = [(g.row_ids, g.idx, g.val, g.mask) for g in ug]
-            ig = [(g.row_ids, g.idx, g.val, g.mask) for g in ig]
+            ug, ig = self.device_groups(matrix)
             reg = jnp.float32(self.reg_param)
             alpha = jnp.float32(self.alpha)
             if callback is None:

@@ -26,7 +26,7 @@ import optax
 import pandas as pd
 
 from albedo_tpu.datasets.ragged import segment_positions
-from albedo_tpu.features.pipeline import Transformer
+from albedo_tpu.features.pipeline import Transformer, memo_map
 from albedo_tpu.parallel.mesh import DATA_AXIS, replicated
 
 
@@ -98,7 +98,9 @@ class Word2VecModel(Transformer):
     def transform(self, df: pd.DataFrame) -> pd.DataFrame:
         self.require_cols(df, [self.input_col])
         out = df.copy()
-        out[self.output_col] = [self.document_vector(ws) for ws in df[self.input_col]]
+        out[self.output_col] = memo_map(
+            df[self.input_col], self.document_vector, key=tuple
+        )
         return out
 
     def find_synonyms(self, word: str, k: int = 10) -> list[tuple[str, float]]:
