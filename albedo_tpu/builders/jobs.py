@@ -55,11 +55,13 @@ class JobContext:
         from albedo_tpu.settings import md5
 
         source = str(getattr(args, "tables", None) or f"synthetic-{self.small}")
+        if (tables is None) != (tag is None):
+            # A tag without its dataset (or vice versa) would stamp artifacts
+            # with the wrong identity and resume another dataset's models.
+            raise ValueError("inject tables and tag together, or neither")
         self.tag = tag if tag is not None else md5(source)[:10]
         self._cache: dict[str, object] = {}
         if tables is not None:
-            if tag is None:
-                raise ValueError("injected tables require an explicit tag")
             self._cache["tables"] = tables
 
     def artifact_name(self, base: str) -> str:

@@ -20,9 +20,14 @@ the chip's published bf16 peak (JAX's default f32 matmul precision on TPU
 uses bf16 MXU passes) plus a measured large-GEMM rate as the achievable
 roofline.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...} where
-value is train wall-clock seconds and vs_baseline = value / 619 (lower is
-better). On failure the single line carries "error"/"stage" and rc != 0.
+Output contract: the LAST line printed is the flagship JSON record
+{"metric": "als_train_wallclock_rank50_iter26", "value", "unit",
+"vs_baseline", ...} where value is train wall-clock seconds and vs_baseline =
+value / 619 (lower is better). With the ranker bench enabled (default), two
+additional JSON lines precede it: an early copy of the flagship record
+(emitted before the ranker runs, so a ranker hang cannot discard it) and the
+"ranker_train_wallclock" record. On failure the single line carries
+"error"/"stage" and rc != 0.
 """
 
 from __future__ import annotations
@@ -493,11 +498,16 @@ def main() -> None:
     except Exception as e:  # noqa: BLE001
         fail("evaluate", repr(e), platform=info.get("platform"))
 
-    # Second headline: the LR-ranker job (reference 1h35m). Printed as its own
-    # JSON line BEFORE the ALS line so the driver's last-line parse still sees
-    # the flagship metric; a ranker failure is recorded, not fatal.
+    # Second headline: the LR-ranker job (reference 1h35m). The ALS record is
+    # emitted BEFORE the ranker bench runs (so a ranker hang that trips the
+    # watchdog cannot discard the already-computed flagship result) and then
+    # re-emitted as the final line (the driver parses the last line). A ranker
+    # failure is recorded in the final record, not fatal.
     ranker_error = None
     if os.environ.get("ALBEDO_BENCH_RANKER", "1") != "0":
+        print(json.dumps(als_record(train_s, ndcg, info, flop, mfu, peak_source,
+                                    gemm_f32, gemm_bf16, dispatch_s, phases, None)),
+              flush=True)
         try:
             print(json.dumps(ranker_bench()), flush=True)
         except Exception as e:  # noqa: BLE001
@@ -505,38 +515,45 @@ def main() -> None:
 
     print(
         json.dumps(
-            {
-                "metric": "als_train_wallclock_rank50_iter26",
-                "value": round(train_s, 3),
-                "unit": "s",
-                "vs_baseline": round(train_s / BASELINE_ALS_TRAIN_S, 5),
-                "ndcg30": round(float(ndcg), 5),
-                "baseline_s": BASELINE_ALS_TRAIN_S,
-                "platform": info.get("platform"),
-                "device_kind": info.get("device_kind"),
-                "mfu": round(mfu, 6),
-                "mfu_peak_source": peak_source,
-                "model_flops": round(flop["flops"]),
-                "flops_per_iter": round(flop["per_iter"]),
-                "padded_entries": flop["padded_entries"],
-                "logical_entries": flop["logical_entries"],
-                "padding_overhead": round(
-                    flop["padded_entries"] / max(1, flop["logical_entries"]), 2
-                ),
-                "logical_nnz": flop["logical_nnz"],
-                "measured_gemm_tflops": round(gemm_f32 / 1e12, 2),
-                "measured_gemm_tflops_bf16": round(gemm_bf16 / 1e12, 2),
-                "dispatch_latency_ms": round(dispatch_s * 1e3, 2),
-                "achieved_tflops": round(flop["flops"] / train_s / 1e12, 4),
-                "vs_measured_roofline": round(
-                    flop["flops"] / train_s / max(gemm_f32, 1.0), 4
-                ),
-                "phase_breakdown": phases,
-                "ranker_error": ranker_error,
-            }
+            als_record(train_s, ndcg, info, flop, mfu, peak_source,
+                       gemm_f32, gemm_bf16, dispatch_s, phases, ranker_error)
         ),
         flush=True,
     )
+
+
+def als_record(train_s, ndcg, info, flop, mfu, peak_source,
+               gemm_f32, gemm_bf16, dispatch_s, phases, ranker_error) -> dict:
+    """The flagship metric record (shared by the early emit and the final line)."""
+    return {
+        "metric": "als_train_wallclock_rank50_iter26",
+        "value": round(train_s, 3),
+        "unit": "s",
+        "vs_baseline": round(train_s / BASELINE_ALS_TRAIN_S, 5),
+        "ndcg30": round(float(ndcg), 5),
+        "baseline_s": BASELINE_ALS_TRAIN_S,
+        "platform": info.get("platform"),
+        "device_kind": info.get("device_kind"),
+        "mfu": round(mfu, 6),
+        "mfu_peak_source": peak_source,
+        "model_flops": round(flop["flops"]),
+        "flops_per_iter": round(flop["per_iter"]),
+        "padded_entries": flop["padded_entries"],
+        "logical_entries": flop["logical_entries"],
+        "padding_overhead": round(
+            flop["padded_entries"] / max(1, flop["logical_entries"]), 2
+        ),
+        "logical_nnz": flop["logical_nnz"],
+        "measured_gemm_tflops": round(gemm_f32 / 1e12, 2),
+        "measured_gemm_tflops_bf16": round(gemm_bf16 / 1e12, 2),
+        "dispatch_latency_ms": round(dispatch_s * 1e3, 2),
+        "achieved_tflops": round(flop["flops"] / train_s / 1e12, 4),
+        "vs_measured_roofline": round(
+            flop["flops"] / train_s / max(gemm_f32, 1.0), 4
+        ),
+        "phase_breakdown": phases,
+        "ranker_error": ranker_error,
+    }
 
 
 if __name__ == "__main__":

@@ -1,0 +1,119 @@
+"""External-anchor validation of the implicit-ALS trainer.
+
+The `implicit` package is not installed in this image, so the anchor is the
+EXACT dense-solve reference: an independent numpy implementation of the
+Hu-Koren-Volinsky normal equations with Spark MLlib's conventions
+(c = 1 + alpha*r, regParam scaled by the row's rating count, item-then-user
+sweep order — ``ALSRecommenderBuilder.scala:46-58``). The production trainer
+must track it iteration-for-iteration at mid scale, and its retrieval quality
+must follow a pinned recall-vs-iterations curve. Either assertion fails if
+factor quality drifts (optimizer bugs, precision regressions, bucketing bugs).
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from albedo_tpu.datasets import random_split_by_user, synthetic_stars  # noqa: E402
+from albedo_tpu.models.als import ImplicitALS  # noqa: E402
+
+
+def dense_implicit_als(matrix, rank, reg, alpha, iters, seed):
+    """Independent dense reference: full normal-equation solves per row, no
+    bucketing, no jax — numpy only. Matches ImplicitALS's init + sweep order."""
+    import jax.numpy as jnp  # init must match the trainer's PRNG exactly
+
+    key = jax.random.PRNGKey(seed)
+    ukey, ikey = jax.random.split(key)
+    scale = 1.0 / np.sqrt(rank)
+    uf = np.asarray(jax.random.normal(ukey, (matrix.n_users, rank), jnp.float32)) * scale
+    vf = np.asarray(jax.random.normal(ikey, (matrix.n_items, rank), jnp.float32)) * scale
+
+    def half(source, target, indptr, indices, vals):
+        yty = source.T @ source
+        out = target.copy()
+        for r in range(indptr.shape[0] - 1):
+            lo, hi = int(indptr[r]), int(indptr[r + 1])
+            if hi == lo:
+                continue
+            y = source[indices[lo:hi]]
+            c1 = alpha * vals[lo:hi]
+            a_mat = yty + (y * c1[:, None]).T @ y + reg * (hi - lo) * np.eye(rank)
+            b_vec = ((1.0 + c1)[:, None] * y).sum(axis=0)
+            out[r] = np.linalg.solve(a_mat, b_vec)
+        return out
+
+    csr = matrix.csr()
+    csc = matrix.csc()
+    for _ in range(iters):
+        vf = half(uf, vf, *csc)   # items first (MLlib order)
+        uf = half(vf, uf, *csr)
+    return uf, vf
+
+
+@pytest.fixture(scope="module")
+def mid_matrix():
+    return synthetic_stars(n_users=800, n_items=500, rank=12, mean_stars=25, seed=13)
+
+
+def test_fit_tracks_dense_reference_at_mid_scale(mid_matrix):
+    """The fused bucketed trainer and the dense numpy reference must agree on
+    the final factors after multiple alternating sweeps."""
+    rank, reg, alpha, iters, seed = 16, 0.4, 20.0, 5, 3
+    ref_uf, ref_vf = dense_implicit_als(mid_matrix, rank, reg, alpha, iters, seed)
+    got = ImplicitALS(
+        rank=rank, reg_param=reg, alpha=alpha, max_iter=iters, seed=seed
+    ).fit(mid_matrix)
+    # Iterated Cholesky vs np.linalg.solve accumulate slightly differently;
+    # the factors must still agree to ~0.1%.
+    np.testing.assert_allclose(got.user_factors, ref_uf, rtol=5e-3, atol=5e-4)
+    np.testing.assert_allclose(got.item_factors, ref_vf, rtol=5e-3, atol=5e-4)
+
+
+def recall_at_k(model, train, test, k=30, n_users=300):
+    """Fraction of held-out positives recovered in the top-k (seen excluded)."""
+    from albedo_tpu.datasets.ragged import padded_rows
+
+    test_csr = test.csr()
+    counts = np.diff(test_csr[0])
+    users = np.nonzero(counts > 0)[0][:n_users]
+    indptr, cols, _ = train.csr()
+    excl = padded_rows(indptr, cols, users)
+    _, idx = model.recommend(users, k=k, exclude_idx=excl)
+    hits = total = 0
+    for row, u in enumerate(users):
+        lo, hi = test_csr[0][u], test_csr[0][u + 1]
+        actual = set(test_csr[1][lo:hi].tolist())
+        hits += len(actual & set(idx[row].tolist()))
+        total += len(actual)
+    return hits / max(1, total)
+
+
+def test_recall_vs_iterations_curve(mid_matrix):
+    """Retrieval quality must improve with sweeps and end above a pinned
+    floor — the drift gate for anything that degrades factor quality without
+    breaking exact parity (e.g. a precision regression)."""
+    train, test = random_split_by_user(mid_matrix, test_ratio=0.2, seed=5)
+    als = ImplicitALS(rank=16, reg_param=0.1, alpha=40.0, max_iter=12, seed=0)
+
+    checkpoints = {1, 3, 12}
+    curve = {}
+
+    def track(it, uf, vf):
+        if it + 1 in checkpoints:
+            from albedo_tpu.models.als import ALSModel
+
+            curve[it + 1] = recall_at_k(
+                ALSModel(user_factors=uf, item_factors=vf, rank=als.rank), train, test
+            )
+
+    als.fit(train, callback=track)
+    # Monotone-ish improvement: later checkpoints never fall below earlier
+    # ones by more than noise, and the curve spans a real gain.
+    assert curve[3] >= curve[1] - 0.02, curve
+    assert curve[12] >= curve[3] - 0.02, curve
+    assert curve[12] >= curve[1] + 0.05, curve
+    # Pinned floor: planted rank-12 structure at this scale recovers well over
+    # a third of held-out stars in the top-30 (observed ~baseline, see commit).
+    assert curve[12] > 0.35, curve
