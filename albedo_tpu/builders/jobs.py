@@ -383,6 +383,72 @@ def train_lr_job(args) -> None:
     _report("train_lr", "NDCG@30", result.ndcg or 0.0, t0)
 
 
+def _holdout_cf_ndcg(ctx: JobContext, rec_cls) -> float:
+    """NDCG@30 for the memory-based CFs under a held-out split.
+
+    The CF recommenders drop the user's own stars from the ranked list
+    (``train_item_cf.py:38`` behavior), so the full-matrix protocol the other
+    builders use (actual = recent stars the model trained on) would score an
+    exact 0 by construction; they are evaluated on held-out stars instead:
+    fit on the train split, recommend with train stars excluded, score
+    against each user's held-out items."""
+    from albedo_tpu.datasets import random_split_by_user
+
+    matrix = ctx.matrix()
+    train, test = random_split_by_user(matrix, test_ratio=0.1, seed=42)
+    rec = rec_cls(train, top_k=TOP_K)
+    users_dense = sample_test_users(test, n=250, seed=42)
+    frame = rec.recommend_for_users(matrix.user_ids[users_dense])
+    predicted = user_items_from_pairs(
+        matrix.users_of(frame["user_id"].to_numpy(np.int64)),
+        matrix.items_of(frame["repo_id"].to_numpy(np.int64)),
+        order_key=frame["score"].to_numpy(np.float64),
+        k=TOP_K,
+    )
+    actual = user_actual_items(test, k=TOP_K)
+    return RankingEvaluator(metric_name="ndcg@k", k=TOP_K).evaluate(predicted, actual)
+
+
+@register_job("item_cf")
+def item_cf_job(args) -> None:
+    """``train_item_cf`` legacy-trainer parity: item-item cosine CF, NDCG@30
+    on a held-out split."""
+    from albedo_tpu.recommenders.cf import ItemCFRecommender
+
+    t0 = time.time()
+    ndcg = _holdout_cf_ndcg(JobContext(args), ItemCFRecommender)
+    _report("item_cf", "NDCG@30", ndcg, t0)
+
+
+@register_job("user_cf")
+def user_cf_job(args) -> None:
+    """``train_user_cf`` legacy-trainer parity: user-user dice CF, NDCG@30 on
+    a held-out split."""
+    from albedo_tpu.recommenders.cf import UserCFRecommender
+
+    t0 = time.time()
+    ndcg = _holdout_cf_ndcg(JobContext(args), UserCFRecommender)
+    _report("user_cf", "NDCG@30", ndcg, t0)
+
+
+@register_job("tfidf_content")
+def tfidf_content_job(args) -> None:
+    """``train_content_based`` legacy-trainer parity: tf-idf similar-repo
+    search. Prints the most-similar repos for the most-starred repo (the
+    reference prints a query's top-49, ``train_content_based.py:62-66``) and
+    reports indexed-corpus size."""
+    from albedo_tpu.recommenders.tfidf import TfidfSimilaritySearch
+
+    t0 = time.time()
+    ctx = JobContext(args)
+    repo = ctx.tables().repo_info
+    search = TfidfSimilaritySearch(min_df=2).fit(repo)
+    top_repo = repo.sort_values("repo_stargazers_count", ascending=False).iloc[0]
+    for score, name in search.similar(str(top_repo["repo_full_name"]), k=10):
+        print(f"[tfidf_content] {score:.4f} {name}")
+    _report("tfidf_content", "indexed_repos", float(len(search.doc_ids)), t0)
+
+
 @register_job("collect_data")
 def collect_data_job(args) -> None:
     """``collect_data`` Django command parity: crawl GitHub into a sqlite

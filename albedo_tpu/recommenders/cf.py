@@ -7,19 +7,24 @@ similarity over the binary user x item matrix, predictions
 similarity, predictions ``S @ R / |S|.sum(axis=1)``), both over
 ``prepare_user_item_df``'s dense 0/1 matrix (``app/utils_repo.py:14-54``).
 
-TPU-first design: the reference materializes the full item x item (or
-user x user) similarity matrix with sklearn ``pairwise_distances`` on the
-host. Here the similarity matrix is NEVER materialized — for binary data the
-prediction factorizes into two tall GEMMs per requested-user block:
+TPU-first design: the reference materializes the dense user x item matrix AND
+the full item x item (or user x user) similarity matrix on the host — neither
+survives albedo scale (10^5 x 10^5 is tens of GB). Here NOTHING quadratic is
+materialized: the utility matrix stays CSR, bucketed into the same padded
+fixed-shape row groups the ALS sweep uses (``datasets.ragged``), and each
+prediction factorizes into two sparse passes per requested-user block:
 
   item-CF:  P_B = (R_B @ Rhat^T) @ Rhat,  Rhat = R / sqrt(item_counts)
-  user-CF:  P_B = S_B @ R,                S_B = 2 (R_B @ R^T) / (n_B + n)
+  user-CF:  P_B = S_B @ R,  S_B = 2 (R_B @ R^T) / (n_B + n), renormalized
 
-with the cosine normalizer ``|S|.sum(axis=1)`` reduced to two matvecs
-(``Rhat^T (Rhat @ 1)``; exact because cosine of binary vectors is
-non-negative). Both run as MXU GEMMs under jit, blocked over requested users,
-with the user's own stars masked out before ``lax.top_k`` (the reference drops
-starred items from the ranked list).
+Pass 1 (``x @ W^T``) is a scanned gather-einsum over the padded row groups;
+pass 2 (``m @ W``) is the transposed scatter-add. Per-bucket work is one MXU
+einsum of at most ``max_entries`` gathered elements, so device memory is
+O(B x n_items + max_entries x B) regardless of matrix size. The cosine
+normalizer ``|S|.sum(axis=1)`` reduces to two sparse matvecs over the same
+groups (exact: similarities of binary vectors are non-negative). The user's
+own stars are masked before ``lax.top_k`` (the reference drops starred items
+from the ranked list).
 """
 
 from __future__ import annotations
@@ -31,47 +36,121 @@ import jax.numpy as jnp
 import numpy as np
 import pandas as pd
 
+from albedo_tpu.datasets.ragged import _pad_len, bucket_rows, group_buckets, padded_rows
 from albedo_tpu.datasets.star_matrix import StarMatrix
 from albedo_tpu.recommenders.base import Recommender
 
 
-def _dense_binary(matrix: StarMatrix) -> np.ndarray:
-    """The 0/1 utility matrix (``prepare_user_item_df`` analogue)."""
-    r = np.zeros((matrix.n_users, matrix.n_items), dtype=np.float32)
-    r[matrix.rows, matrix.cols] = 1.0
-    return r
+def sparse_row_groups(
+    matrix: StarMatrix,
+    item_weights: np.ndarray | None = None,
+    max_entries: int = 1 << 18,
+    batch_size: int = 1024,
+) -> list[tuple]:
+    """The binary utility matrix as stacked padded CSR row groups on device.
+
+    ``item_weights`` (n_items,) reweights entries (``Rhat`` columns); default
+    binary 1.0. Returns ``(row_ids, idx, val)`` tuples as the kernels below
+    consume them.
+    """
+    import jax as _jax
+
+    indptr, cols, _ = matrix.csr()
+    vals = np.ones(matrix.nnz, dtype=np.float32)
+    buckets = bucket_rows(indptr, cols, vals, batch_size=batch_size, max_entries=max_entries)
+    groups = []
+    for g in group_buckets(buckets):
+        val = g.val
+        if item_weights is not None:
+            val = item_weights[g.idx].astype(np.float32) * g.mask
+        # The kernels only need (row_ids, idx, val): padding already carries
+        # zero val, so the bool mask never ships to device.
+        groups.append(
+            (_jax.device_put(g.row_ids), _jax.device_put(g.idx), _jax.device_put(val))
+        )
+    return groups
 
 
-@functools.partial(jax.jit, static_argnames=("k",))
-def _item_cf_block(r_block, rhat, rowsum_s, starred_mask, k: int):
-    """(B, I) item-CF scores for one user block -> top-k (vals, idx)."""
-    sims = (r_block @ rhat.T) @ rhat              # (B, I): R_B Rhat^T Rhat
-    scores = sims / jnp.maximum(rowsum_s, 1e-12)
-    scores = jnp.where(starred_mask, -jnp.inf, scores)
-    return jax.lax.top_k(scores, k)
+def gather_matmul_t(x: jax.Array, groups: list[tuple], n_rows: int) -> jax.Array:
+    """``x @ W^T`` for a row-sparse ``W`` ((n_rows, n_cols) as padded groups);
+    ``x`` is (B, n_cols) dense. One gather-einsum per bucket, scanned."""
+
+    def body(m, g):
+        rows, idx, val = g
+        block = jnp.einsum("bcl,cl->bc", x[:, idx], val)
+        safe = jnp.where(rows < 0, n_rows, rows)
+        return m.at[:, safe].set(block, mode="drop"), None
+
+    m = jnp.zeros((x.shape[0], n_rows), x.dtype)
+    for g in groups:
+        m, _ = jax.lax.scan(body, m, g)
+    return m
 
 
-@functools.partial(jax.jit, static_argnames=("k",))
-def _user_cf_block(r_block, r_all, n_block, n_all, starred_mask, k: int):
-    """(B, I) user-CF (dice) scores for one user block -> top-k (vals, idx)."""
-    inter = r_block @ r_all.T                     # (B, U) co-star counts
-    sims = 2.0 * inter / jnp.maximum(n_block[:, None] + n_all[None, :], 1e-12)
-    denom = jnp.maximum(sims.sum(axis=1, keepdims=True), 1e-12)
-    scores = (sims @ r_all) / denom
-    scores = jnp.where(starred_mask, -jnp.inf, scores)
-    return jax.lax.top_k(scores, k)
+def scatter_matmul(m: jax.Array, groups: list[tuple], n_cols: int) -> jax.Array:
+    """``m @ W`` for the same row-sparse ``W``; ``m`` is (B, n_rows) dense.
+    Padding slots carry zero ``val``, so clipped row gathers contribute 0."""
+
+    def body(p, g):
+        rows, idx, val = g
+        msel = m[:, jnp.clip(rows, 0)]                     # (B, Bc)
+        contrib = jnp.einsum("bc,cl->bcl", msel, val)
+        return p.at[:, idx.reshape(-1)].add(contrib.reshape(m.shape[0], -1)), None
+
+    p = jnp.zeros((m.shape[0], n_cols), m.dtype)
+    for g in groups:
+        p, _ = jax.lax.scan(body, p, g)
+    return p
 
 
-class _MemoryCFRecommender(Recommender):
-    """Shared blocked-GEMM recommend loop for both memory-based CFs."""
+def row_sums(groups: list[tuple], n_rows: int) -> jax.Array:
+    """``W @ 1`` (per-row weight sums) over the padded groups."""
+
+    def body(t, g):
+        rows, _, val = g
+        safe = jnp.where(rows < 0, n_rows, rows)
+        return t.at[safe].set(val.sum(axis=1), mode="drop"), None
+
+    t = jnp.zeros((n_rows,), jnp.float32)
+    for g in groups:
+        t, _ = jax.lax.scan(body, t, g)
+    return t
+
+
+def col_weighted_sums(groups: list[tuple], t: jax.Array, n_cols: int) -> jax.Array:
+    """``W^T t`` (column sums weighted by per-row ``t``) over the groups."""
+
+    def body(out, g):
+        rows, idx, val = g
+        tsel = t[jnp.clip(rows, 0)]                        # (Bc,) 0-weighted pads
+        contrib = (val * tsel[:, None]).reshape(-1)
+        return out.at[idx.reshape(-1)].add(contrib), None
+
+    out = jnp.zeros((n_cols,), jnp.float32)
+    for g in groups:
+        out, _ = jax.lax.scan(body, out, g)
+    return out
+
+
+def _dense_user_block(star_idx: jax.Array, n_items: int) -> jax.Array:
+    """(B, n_items) binary rows from padded star lists (-1 = pad)."""
+    b = star_idx.shape[0]
+    r = jnp.zeros((b, n_items + 1), jnp.float32)
+    safe = jnp.where(star_idx < 0, n_items, star_idx)
+    r = r.at[jnp.arange(b)[:, None], safe].set(1.0)
+    return r[:, :n_items]
+
+
+class _SparseCFRecommender(Recommender):
+    """Shared blocked sparse-GEMM recommend loop for both memory-based CFs."""
 
     def __init__(self, matrix: StarMatrix, user_block: int = 256, **kwargs):
         super().__init__(**kwargs)
         self.matrix = matrix
         self.user_block = user_block
-        self._r = _dense_binary(matrix)
+        self._indptr, self._cols, _ = matrix.csr()
 
-    def _score_block(self, r_block: jnp.ndarray, starred: jnp.ndarray, k: int):
+    def _score_block(self, star_idx: jax.Array, k: int):
         raise NotImplementedError
 
     def recommend_for_users(self, user_ids: np.ndarray) -> pd.DataFrame:
@@ -81,13 +160,23 @@ class _MemoryCFRecommender(Recommender):
         req_users = np.asarray(user_ids, dtype=np.int64)[known]
         k = min(self.top_k, self.matrix.n_items)
 
+        # One fixed shape — (user_block, length tier of the longest requested
+        # row) — for every block, so the scan-heavy score function compiles
+        # once per call pattern instead of per distinct (B, width). The width
+        # only feeds the cheap (B, width) -> (B, n_items) binary scatter, so
+        # over-padding short blocks costs nothing material.
+        lens = self._indptr[rows + 1] - self._indptr[rows]
+        width = _pad_len(max(1, int(lens.max())) if rows.size else 1, 8)
+
         out_users, out_items, out_scores = [], [], []
         for start in range(0, len(rows), self.user_block):
             block = rows[start : start + self.user_block]
-            r_block = jnp.asarray(self._r[block])
-            starred = r_block > 0
-            vals, idx = self._score_block(r_block, starred, k)
-            vals, idx = np.asarray(vals), np.asarray(idx)
+            raw = padded_rows(self._indptr, self._cols, block)
+            star_idx = np.full((self.user_block, width), -1, dtype=np.int32)
+            star_idx[: raw.shape[0], : raw.shape[1]] = raw
+            vals, idx = self._score_block(jnp.asarray(star_idx), k)
+            vals = np.asarray(vals)[: len(block)]
+            idx = np.asarray(idx)[: len(block)]
             ok = np.isfinite(vals)
             b_users = np.repeat(req_users[start : start + self.user_block], k).reshape(-1, k)
             out_users.append(b_users[ok])
@@ -103,35 +192,63 @@ class _MemoryCFRecommender(Recommender):
         )
 
 
-class ItemCFRecommender(_MemoryCFRecommender):
+class ItemCFRecommender(_SparseCFRecommender):
     """Item-item CF with cosine similarity (``train_item_cf.py:38``)."""
 
     source = "item_cf"
 
     def __init__(self, matrix: StarMatrix, **kwargs):
         super().__init__(matrix, **kwargs)
-        counts = self._r.sum(axis=0)                        # stars per item
+        counts = matrix.item_counts().astype(np.float64)
         inv_norm = np.where(counts > 0, 1.0 / np.sqrt(np.maximum(counts, 1e-12)), 0.0)
-        self._rhat = jnp.asarray(self._r * inv_norm[None, :].astype(np.float32))
-        # |S|.sum(axis=1) = Rhat^T (Rhat @ 1): two matvecs, never the I x I
-        # similarity matrix; exact because S is non-negative for binary data.
-        ones_items = jnp.ones((self.matrix.n_items,), jnp.float32)
-        self._rowsum_s = self._rhat.T @ (self._rhat @ ones_items)
+        self._groups_hat = sparse_row_groups(matrix, item_weights=inv_norm)
+        n_users, n_items = matrix.n_users, matrix.n_items
+        # |S|.sum(axis=1) = Rhat^T (Rhat @ 1): two sparse matvecs, never the
+        # I x I similarity matrix; exact because S is non-negative for binary R.
+        t = row_sums(self._groups_hat, n_users)
+        self._rowsum_s = col_weighted_sums(self._groups_hat, t, n_items)
 
-    def _score_block(self, r_block, starred, k):
-        return _item_cf_block(r_block, self._rhat, self._rowsum_s, starred, k)
+        @functools.partial(jax.jit, static_argnames=("k",))
+        def score(star_idx, groups, rowsum_s, k: int):
+            r_block = _dense_user_block(star_idx, n_items)
+            m1 = gather_matmul_t(r_block, groups, n_users)   # R_B @ Rhat^T
+            p = scatter_matmul(m1, groups, n_items)          # ... @ Rhat
+            scores = p / jnp.maximum(rowsum_s, 1e-12)
+            scores = jnp.where(r_block > 0, -jnp.inf, scores)
+            return jax.lax.top_k(scores, k)
+
+        self._score = score
+
+    def _score_block(self, star_idx, k):
+        return self._score(star_idx, self._groups_hat, self._rowsum_s, k)
 
 
-class UserCFRecommender(_MemoryCFRecommender):
+class UserCFRecommender(_SparseCFRecommender):
     """User-user CF with dice similarity (``train_user_cf.py:37``)."""
 
     source = "user_cf"
 
     def __init__(self, matrix: StarMatrix, **kwargs):
         super().__init__(matrix, **kwargs)
-        self._r_dev = jnp.asarray(self._r)
-        self._n_all = jnp.asarray(self._r.sum(axis=1))      # stars per user
+        self._groups = sparse_row_groups(matrix)
+        self._n_all = jnp.asarray(
+            np.diff(self._indptr).astype(np.float32)
+        )  # stars per user
+        n_items = matrix.n_items  # bind locals: the closure must not pin self
 
-    def _score_block(self, r_block, starred, k):
-        n_block = r_block.sum(axis=1)
-        return _user_cf_block(r_block, self._r_dev, n_block, self._n_all, starred, k)
+        @functools.partial(jax.jit, static_argnames=("k",))
+        def score(star_idx, groups, n_all, k: int):
+            n_users = n_all.shape[0]
+            r_block = _dense_user_block(star_idx, n_items)
+            inter = gather_matmul_t(r_block, groups, n_users)   # (B, U)
+            n_block = r_block.sum(axis=1)
+            sims = 2.0 * inter / jnp.maximum(n_block[:, None] + n_all[None, :], 1e-12)
+            denom = jnp.maximum(sims.sum(axis=1, keepdims=True), 1e-12)
+            p = scatter_matmul(sims / denom, groups, n_items)
+            scores = jnp.where(r_block > 0, -jnp.inf, p)
+            return jax.lax.top_k(scores, k)
+
+        self._score = score
+
+    def _score_block(self, star_idx, k):
+        return self._score(star_idx, self._groups, self._n_all, k)

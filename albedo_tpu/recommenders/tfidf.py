@@ -74,7 +74,7 @@ class TfidfSimilaritySearch:
         for d in docs:
             df_counts.update(set(d))
         terms = sorted(w for w, c in df_counts.items() if c >= self.min_df)
-        self.vocab = {w: i} if False else {w: i for i, w in enumerate(terms)}
+        self.vocab = {w: i for i, w in enumerate(terms)}
         n_docs = len(docs)
         v = len(terms)
         # sklearn smooth idf: ln((1 + n) / (1 + df)) + 1.
