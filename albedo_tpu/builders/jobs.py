@@ -488,7 +488,12 @@ def sync_index_job(args) -> None:
 
 @register_job("cv_lr")
 def cv_lr_job(args) -> None:
-    """``LogisticRegressionRankerCV`` — grid over instance-weight columns."""
+    """``LogisticRegressionRankerCV`` — grid over instance-weight columns.
+
+    The featurized set is built ONCE and the five weight-column LR fits run
+    as a single vmapped L-BFGS solve (``LogisticRegression.fit_many``), the
+    reference CV's materialize-once-then-grid structure
+    (``LogisticRegressionRankerCV.scala:275-288,326-332``)."""
     from albedo_tpu.builders.ranker import RankerConfig, train_ranker
     from albedo_tpu.features.weights import WEIGHT_COLUMNS
 
@@ -497,20 +502,18 @@ def cv_lr_job(args) -> None:
     up, uc, rp, rc = ctx.profiles()
     als = ctx.als_model()
     lo, hi = ctx.star_range()
-    results = []
-    for weight_col in WEIGHT_COLUMNS:
-        config = RankerConfig(
-            popular_min_stars=lo, popular_max_stars=hi, weight_col=weight_col,
-            min_df=3 if ctx.small else 10, lr_max_iter=60 if ctx.small else 300,
-        )
-        if ctx.small:
-            config = config.small()
-        r = train_ranker(
-            ctx.tables(), up, uc, rp, rc, als, ctx.matrix(), ctx.word2vec(),
-            now=ctx.now, config=config,
-        )
-        results.append((weight_col, r.auc))
-        print(f"[cv_lr] {weight_col} -> AUC {r.auc:.6f}")
-    best = max(results, key=lambda x: x[1])
+    config = RankerConfig(
+        popular_min_stars=lo, popular_max_stars=hi,
+        min_df=3 if ctx.small else 10, lr_max_iter=60 if ctx.small else 300,
+    )
+    if ctx.small:
+        config = config.small()
+    r = train_ranker(
+        ctx.tables(), up, uc, rp, rc, als, ctx.matrix(), ctx.word2vec(),
+        now=ctx.now, config=config, weight_cols=WEIGHT_COLUMNS,
+    )
+    for weight_col, auc in r.grid:
+        print(f"[cv_lr] {weight_col} -> AUC {auc:.6f}")
+    best = r.grid[0]
     print(f"[cv_lr] best weight column = {best[0]}")
     _report("cv_lr", "AUC", best[1], t0)

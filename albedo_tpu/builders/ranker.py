@@ -144,6 +144,9 @@ class RankerResult:
     auc: float
     ndcg: float | None
     n_rows: int = 0  # balanced (positive + sampled-negative) training rows
+    # Weight-column CV grid results [(weight_col, auc)], best first, when
+    # train_ranker ran with weight_cols (LogisticRegressionRankerCV parity).
+    grid: list | None = None
 
 
 def reduce_starring(starring: pd.DataFrame, max_count: int) -> pd.DataFrame:
@@ -217,12 +220,20 @@ def train_ranker(
     recommenders: Sequence[Recommender] | None = None,
     eval_actual: "UserItems | None" = None,
     timer=None,
+    weight_cols: Sequence[str] | None = None,
+    grid_mesh=None,
 ) -> RankerResult:
     """End-to-end ranker training + evaluation (SURVEY.md §3.2).
 
     ``timer`` (``albedo_tpu.utils.profiling.Timer``) if given records per-stage
     wall-clock — the bench's stage breakdown vs the reference's 1h35m job
     (``Makefile:209``).
+
+    ``weight_cols`` switches the LR stage into CV-grid mode
+    (``LogisticRegressionRankerCV.scala:326-332``): the SHARED featurized set
+    is fit once per weight column in a single vmapped L-BFGS solve
+    (optionally grid-sharded over ``grid_mesh``), each scored by AUC; the best
+    column's model continues into fusion/NDCG and the full grid is returned.
     """
     rng = np.random.default_rng(config.seed)
     if timer is None:
@@ -275,20 +286,35 @@ def train_ranker(
         weigher = InstanceWeigher(now=now)
         train_w = weigher.transform(train_df)
         fm_train = assembler.assemble(train_w)
+    grid = None
     with timer.section("lr_fit"):
         lr = LogisticRegression(max_iter=config.lr_max_iter, reg_param=config.lr_reg_param)
-        lr_model = lr.fit(
-            fm_train,
-            train_w["starring"].to_numpy(np.float32),
-            sample_weight=train_w[config.weight_col].to_numpy(np.float32),
-        )
+        labels = train_w["starring"].to_numpy(np.float32)
+        if not weight_cols:
+            lr_model = lr.fit(
+                fm_train, labels,
+                sample_weight=train_w[config.weight_col].to_numpy(np.float32),
+            )
+        else:
+            ws = np.stack(
+                [train_w[c].to_numpy(np.float32) for c in weight_cols]
+            )
+            grid_models = lr.fit_many(fm_train, labels, ws, grid_mesh=grid_mesh)
 
     # 6a. AUC on the held-out split (:354-364).
     with timer.section("auc_eval"):
         fm_test = assembler.assemble(test_df)
-        auc = area_under_roc(
-            test_df["starring"].to_numpy(np.float32), lr_model.predict_proba(fm_test)
-        )
+        test_labels = test_df["starring"].to_numpy(np.float32)
+        if not weight_cols:
+            auc = area_under_roc(test_labels, lr_model.predict_proba(fm_test))
+        else:
+            scored = [
+                (col, float(area_under_roc(test_labels, m.predict_proba(fm_test))), m)
+                for col, m in zip(weight_cols, grid_models)
+            ]
+            scored.sort(key=lambda t: -t[1])
+            grid = [(col, auc_g) for col, auc_g, _ in scored]
+            _, auc, lr_model = scored[0]
 
     model = RankerModel(
         feature_pipeline=feature_model,
@@ -322,4 +348,6 @@ def train_ranker(
                 predicted, actual
             )
 
-    return RankerResult(model=model, auc=float(auc), ndcg=ndcg, n_rows=len(train_df))
+    return RankerResult(
+        model=model, auc=float(auc), ndcg=ndcg, n_rows=len(train_df), grid=grid
+    )

@@ -121,6 +121,81 @@ class LogisticRegression:
             center=None if center is None else np.asarray(center),
         )
 
+    def fit_many(
+        self,
+        fm: FeatureMatrix,
+        labels: np.ndarray,
+        sample_weights: np.ndarray,   # (G, N): one row per grid point
+        grid_mesh: Any | None = None,
+    ) -> list[LogisticRegressionModel]:
+        """Fit one model per row of ``sample_weights`` in a single vmapped
+        L-BFGS solve — the ``LogisticRegressionRankerCV`` instance-weight grid
+        (``LogisticRegressionRankerCV.scala:326-332``), which refits the SAME
+        featurized set under different weight columns.
+
+        The features, labels, scales, and init are shared; only the weight
+        vector varies, so the grid vectorizes cleanly. With ``grid_mesh`` the
+        grid axis is laid out over the mesh's data axis (padded to a device
+        multiple): each device solves its own grid points — the TPU analogue
+        of Spark CV's parallel fits over the cluster.
+        """
+        if self.solver != "lbfgs":
+            raise ValueError(f"fit_many supports solver='lbfgs' only, not {self.solver!r}")
+        if self.mesh is not None:
+            raise ValueError(
+                "fit_many shards the GRID axis via grid_mesh; combining it with "
+                "a row-sharded batch (self.mesh) is not supported"
+            )
+        ws = np.asarray(sample_weights, dtype=np.float32)
+        n_grid = ws.shape[0]
+        if n_grid == 0:
+            raise ValueError("sample_weights must have at least one grid row")
+        batch = feature_batch(fm)
+        y = jnp.asarray(labels, dtype=jnp.float32)
+
+        if self.standardization:
+            scales = jax.tree.map(jnp.asarray, inverse_std_scales(fm))
+            center = jnp.asarray(dense_center(fm))
+        else:
+            scales = jax.tree.map(lambda p: jnp.ones_like(p), init_params(fm))
+            scales["bias"] = jnp.float32(1.0)
+            center = None
+
+        params0 = init_params(fm)
+        reg = float(self.reg_param)
+
+        def solve(w):
+            def loss_fn(p):
+                return weighted_logloss(p, scales, batch, y, w, reg, center=center)
+
+            return _run_lbfgs(loss_fn, params0, self.max_iter, self.tol)
+
+        if grid_mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from albedo_tpu.parallel.mesh import DATA_AXIS
+
+            n_dev = grid_mesh.shape[DATA_AXIS]
+            pad = (-n_grid) % n_dev
+            ws_dev = jax.device_put(
+                np.concatenate([ws, np.repeat(ws[:1], pad, axis=0)]) if pad else ws,
+                NamedSharding(grid_mesh, P(DATA_AXIS, None)),
+            )
+        else:
+            ws_dev = jnp.asarray(ws)
+
+        params, losses = jax.jit(jax.vmap(solve))(ws_dev)
+        center_np = None if center is None else np.asarray(center)
+        return [
+            LogisticRegressionModel(
+                params=jax.tree.map(lambda x, g=g: np.asarray(x[g]), params),
+                scales=scales,
+                train_loss=float(losses[g]),
+                center=center_np,
+            )
+            for g in range(n_grid)
+        ]
+
 
 def _finite_tree(tree) -> jax.Array:
     leaves = jax.tree.leaves(tree)

@@ -42,12 +42,13 @@ from albedo_tpu.recommenders.base import Recommender
 
 
 def sparse_row_groups(
-    matrix: StarMatrix,
+    indptr: np.ndarray,
+    cols: np.ndarray,
     item_weights: np.ndarray | None = None,
     max_entries: int = 1 << 18,
     batch_size: int = 1024,
 ) -> list[tuple]:
-    """The binary utility matrix as stacked padded CSR row groups on device.
+    """A binary CSR utility matrix as stacked padded row groups on device.
 
     ``item_weights`` (n_items,) reweights entries (``Rhat`` columns); default
     binary 1.0. Returns ``(row_ids, idx, val)`` tuples as the kernels below
@@ -55,8 +56,7 @@ def sparse_row_groups(
     """
     import jax as _jax
 
-    indptr, cols, _ = matrix.csr()
-    vals = np.ones(matrix.nnz, dtype=np.float32)
+    vals = np.ones(cols.shape[0], dtype=np.float32)
     buckets = bucket_rows(indptr, cols, vals, batch_size=batch_size, max_entries=max_entries)
     groups = []
     for g in group_buckets(buckets):
@@ -201,7 +201,7 @@ class ItemCFRecommender(_SparseCFRecommender):
         super().__init__(matrix, **kwargs)
         counts = matrix.item_counts().astype(np.float64)
         inv_norm = np.where(counts > 0, 1.0 / np.sqrt(np.maximum(counts, 1e-12)), 0.0)
-        self._groups_hat = sparse_row_groups(matrix, item_weights=inv_norm)
+        self._groups_hat = sparse_row_groups(self._indptr, self._cols, item_weights=inv_norm)
         n_users, n_items = matrix.n_users, matrix.n_items
         # |S|.sum(axis=1) = Rhat^T (Rhat @ 1): two sparse matvecs, never the
         # I x I similarity matrix; exact because S is non-negative for binary R.
@@ -230,7 +230,7 @@ class UserCFRecommender(_SparseCFRecommender):
 
     def __init__(self, matrix: StarMatrix, **kwargs):
         super().__init__(matrix, **kwargs)
-        self._groups = sparse_row_groups(matrix)
+        self._groups = sparse_row_groups(self._indptr, self._cols)
         self._n_all = jnp.asarray(
             np.diff(self._indptr).astype(np.float32)
         )  # stars per user
