@@ -15,6 +15,7 @@ against each user's most recent 30 stars (``ALSRecommenderBuilder.scala:60-105``
 from __future__ import annotations
 
 import argparse
+import threading
 import time
 
 import numpy as np
@@ -431,6 +432,51 @@ def user_cf_job(args) -> None:
     _report("user_cf", "NDCG@30", ndcg, t0)
 
 
+@register_job("ranking_mf")
+def ranking_mf_job(args) -> None:
+    """``train_graphlab`` legacy-trainer parity: ranking factorization on the
+    binary star matrix (binary_target=True, split by user, top-50 with known
+    items excluded — ``train_graphlab.py:23-34``), with repo side features
+    (log-stars/forks) as the linear side-data term; NDCG@30 on the held-out
+    split plus the canary user's top list."""
+    from albedo_tpu.datasets import random_split_by_user
+    from albedo_tpu.datasets.ragged import padded_rows
+    from albedo_tpu.models.ranking_factorization import RankingFactorization
+
+    t0 = time.time()
+    ctx = JobContext(args)
+    matrix = ctx.matrix()
+    train, test = random_split_by_user(matrix, test_ratio=0.2, seed=42)
+
+    # Side data: per-repo activity features, standardized (the reference's
+    # side-data path; its own invocation passes none, so these are additive).
+    repo = ctx.tables().repo_info.set_index("repo_id").reindex(matrix.item_ids)
+    side = np.stack(
+        [
+            np.log1p(repo["repo_stargazers_count"].fillna(0).to_numpy(np.float64)),
+            np.log1p(repo["repo_forks_count"].fillna(0).to_numpy(np.float64)),
+        ],
+        axis=1,
+    )
+    side = (side - side.mean(axis=0)) / np.maximum(side.std(axis=0), 1e-9)
+
+    mf = RankingFactorization(
+        rank=16 if ctx.small else 32, epochs=5 if ctx.small else 10,
+        batch_size=1024 if ctx.small else 8192,
+    )
+    model = mf.fit(train, item_side=side.astype(np.float32))
+
+    users_dense = sample_test_users(test, n=250, seed=42)
+    indptr, cols_arr, _ = train.csr()
+    excl = padded_rows(indptr, cols_arr, users_dense)
+    _, idx = model.recommend(users_dense, k=TOP_K, exclude_idx=excl)
+    predicted = UserItems(users=users_dense, items=idx.astype(np.int32))
+    ndcg = RankingEvaluator(metric_name="ndcg@k", k=TOP_K).evaluate(
+        predicted, user_actual_items(test, k=TOP_K)
+    )
+    _report("ranking_mf", "NDCG@30", ndcg, t0)
+
+
 @register_job("tfidf_content")
 def tfidf_content_job(args) -> None:
     """``train_content_based`` legacy-trainer parity: tf-idf similar-repo
@@ -447,6 +493,63 @@ def tfidf_content_job(args) -> None:
     for score, name in search.similar(str(top_repo["repo_full_name"]), k=10):
         print(f"[tfidf_content] {score:.4f} {name}")
     _report("tfidf_content", "indexed_repos", float(len(search.doc_ids)), t0)
+
+
+@register_job("serve")
+def serve_job(args) -> None:
+    """Django web-layer parity (``app/views.py``, ``app/urls.py``,
+    ``app/admin.py``): serve the index page, top-k recommendations from the
+    trained ALS artifacts, and admin-style repo/user search over HTTP.
+
+    Extra flags: --port N (default 8080), --duration SECONDS (0 = forever).
+    """
+    from albedo_tpu.serving import RecommendationService, serve
+
+    extra = argparse.ArgumentParser()
+    extra.add_argument("--port", type=int, default=8080)
+    extra.add_argument("--duration", type=float, default=0.0)
+    ns, _ = extra.parse_known_args(getattr(args, "_rest", []))
+
+    ctx = JobContext(args)
+    service = RecommendationService(
+        ctx.als_model(), ctx.matrix(),
+        repo_info=ctx.tables().repo_info, user_info=ctx.tables().user_info,
+    )
+    server = serve(service, port=ns.port)
+    host, port = server.server_address[:2]
+    print(f"[serve] listening on http://{host}:{port}/ "
+          f"(/recommend/<user_id>, /admin/repos, /admin/users)")
+    try:
+        if ns.duration > 0:
+            time.sleep(ns.duration)
+        else:
+            threading.Event().wait()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.shutdown()
+
+
+@register_job("play")
+def play_job(args) -> None:
+    """``Playground`` parity (``Playground.scala:44-75``): the manual
+    scratchpad — load raw tables, fit a quick ALS, save it through the
+    artifact store, and print the canary user's top repos."""
+    from albedo_tpu.models.als import ALSModel, ImplicitALS
+
+    t0 = time.time()
+    ctx = JobContext(args)
+    matrix = ctx.matrix()
+    arrays = load_or_create_pickle(
+        ctx.artifact_name("playgroundALS.pkl"),
+        lambda: ImplicitALS(rank=16, max_iter=8).fit(matrix).to_arrays(),
+    )
+    model = ALSModel.from_arrays(arrays)
+    users = ctx.test_user_dense(n=1)
+    _, idx = model.recommend(users[:1], k=10)
+    for rank, item in enumerate(idx[0], 1):
+        print(f"[play] {rank}. repo {matrix.item_ids[int(item)]}")
+    _report("play", "rank", float(model.rank), t0)
 
 
 @register_job("collect_data")

@@ -84,6 +84,29 @@ def memo_map(values, func: Callable[[Any], T], key: Callable[[Any], Any] | None 
     return out
 
 
+class IntermediateCacher(Transformer):
+    """Pipeline stage that snapshots (and optionally column-prunes) the frame
+    flowing through it (``transformers/IntermediateCacher.scala:10-40``).
+
+    Spark's ``.cache()`` materializes a lazy plan so later stages don't
+    recompute it; pandas frames are already materialized, so the load-bearing
+    parts here are the column pruning (``intermediateColumns``) and the
+    retained ``.cached`` snapshot — inspectable mid-pipeline for debugging,
+    and a cut point that drops columns downstream stages don't need.
+    """
+
+    def __init__(self, columns: Sequence[str] | None = None):
+        self.columns = list(columns) if columns else None
+        self.cached: pd.DataFrame | None = None
+
+    def transform(self, df: pd.DataFrame) -> pd.DataFrame:
+        if self.columns:
+            self.require_cols(df, self.columns)
+            df = df[self.columns]
+        self.cached = df
+        return df
+
+
 class PipelineModel(Transformer):
     """A fitted pipeline: transformers applied in sequence."""
 

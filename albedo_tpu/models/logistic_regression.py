@@ -77,6 +77,18 @@ class LogisticRegression:
     # psums that replace MLlib's gradient treeAggregate.
     mesh: Any | None = None
 
+    def _prepare_scales(self, fm: FeatureMatrix):
+        """(scales, center) under the configured standardization — shared by
+        ``fit`` and ``fit_many`` so grid and single fits can never drift."""
+        if self.standardization:
+            scales = jax.tree.map(jnp.asarray, inverse_std_scales(fm))
+            center = jnp.asarray(dense_center(fm))
+        else:
+            scales = jax.tree.map(lambda p: jnp.ones_like(p), init_params(fm))
+            scales["bias"] = jnp.float32(1.0)
+            center = None
+        return scales, center
+
     def fit(
         self,
         fm: FeatureMatrix,
@@ -95,14 +107,7 @@ class LogisticRegression:
             y = jnp.asarray(labels, dtype=jnp.float32)
             w = jnp.asarray(sample_weight, dtype=jnp.float32)
 
-        if self.standardization:
-            scales = jax.tree.map(jnp.asarray, inverse_std_scales(fm))
-            center = jnp.asarray(dense_center(fm))
-        else:
-            scales = jax.tree.map(lambda p: jnp.ones_like(p), init_params(fm))
-            scales["bias"] = jnp.float32(1.0)
-            center = None
-
+        scales, center = self._prepare_scales(fm)
         params = init_params(fm)
         reg = float(self.reg_param)
 
@@ -152,15 +157,7 @@ class LogisticRegression:
             raise ValueError("sample_weights must have at least one grid row")
         batch = feature_batch(fm)
         y = jnp.asarray(labels, dtype=jnp.float32)
-
-        if self.standardization:
-            scales = jax.tree.map(jnp.asarray, inverse_std_scales(fm))
-            center = jnp.asarray(dense_center(fm))
-        else:
-            scales = jax.tree.map(lambda p: jnp.ones_like(p), init_params(fm))
-            scales["bias"] = jnp.float32(1.0)
-            center = None
-
+        scales, center = self._prepare_scales(fm)
         params0 = init_params(fm)
         reg = float(self.reg_param)
 

@@ -1,0 +1,179 @@
+"""HTTP serving layer: index page, top-k recommendations, admin-style search.
+
+Reference parity: the Django web layer — ``app/views.py:6-7`` + ``app/urls.py``
+(an index page rendering ``app/templates/index.html``) and ``app/admin.py``
+(list/search screens over UserInfo/RepoInfo). The reference serves no
+recommendation endpoint (recommendations are printed by the trainers); this
+layer closes that gap the way a user of the framework needs: artifacts trained
+by the builders are loaded once and served read-only.
+
+Design: stdlib ``ThreadingHTTPServer`` — the model forward is a single blocked
+GEMM + top-k on device per request (``ALSModel.recommend``), everything else
+is id-map lookups; no web framework dependency to gate on.
+
+Routes:
+  GET /                      index page (name + route listing, index.html parity)
+  GET /recommend/<user_id>?k=30&exclude_seen=1   JSON top-k for a raw user id
+  GET /admin/repos?q=&limit= repo list/search (admin.py RepoInfoAdmin parity:
+                             full_name/description search, language/stars listed)
+  GET /admin/users?q=&limit= user list/search (UserInfoAdmin parity)
+  GET /healthz               liveness probe
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+import numpy as np
+import pandas as pd
+
+from albedo_tpu.datasets.ragged import padded_rows
+from albedo_tpu.datasets.star_matrix import StarMatrix
+from albedo_tpu.models.als import ALSModel
+
+_INDEX_HTML = """<!doctype html>
+<html><head><title>Albedo-TPU</title></head>
+<body><h1>Albedo-TPU</h1>
+<p>A github repo recommender, served from trained artifacts.</p>
+<ul>
+<li>GET /recommend/&lt;user_id&gt;?k=30&amp;exclude_seen=1</li>
+<li>GET /admin/repos?q=tensor&amp;limit=20</li>
+<li>GET /admin/users?q=vinta&amp;limit=20</li>
+<li>GET /healthz</li>
+</ul></body></html>"""
+
+
+class RecommendationService:
+    """Artifact-backed read-only service: id mapping + model + metadata."""
+
+    def __init__(
+        self,
+        model: ALSModel,
+        matrix: StarMatrix,
+        repo_info: pd.DataFrame | None = None,
+        user_info: pd.DataFrame | None = None,
+    ):
+        self.model = model
+        self.matrix = matrix
+        self.repo_info = repo_info if repo_info is not None else pd.DataFrame()
+        self.user_info = user_info if user_info is not None else pd.DataFrame()
+        self._indptr, self._cols, _ = matrix.csr()
+        self._repo_names = (
+            self.repo_info.set_index("repo_id")["repo_full_name"].to_dict()
+            if "repo_full_name" in self.repo_info.columns
+            else {}
+        )
+
+    def recommend(self, user_id: int, k: int = 30, exclude_seen: bool = True) -> dict:
+        dense = self.matrix.users_of(np.array([user_id], dtype=np.int64))
+        if dense[0] < 0:
+            return {"user_id": user_id, "error": "unknown user", "items": []}
+        excl = padded_rows(self._indptr, self._cols, dense) if exclude_seen else None
+        vals, idx = self.model.recommend(dense, k=k, exclude_idx=excl)
+        items = []
+        for score, item in zip(vals[0], idx[0]):
+            if item < 0 or not np.isfinite(score):
+                continue
+            repo_id = int(self.matrix.item_ids[item])
+            items.append(
+                {
+                    "repo_id": repo_id,
+                    "repo_full_name": self._repo_names.get(repo_id),
+                    "score": float(score),
+                }
+            )
+        return {"user_id": user_id, "k": k, "items": items}
+
+    def search_repos(self, q: str = "", limit: int = 20) -> list[dict]:
+        """RepoInfoAdmin parity: search full_name/description, list language +
+        stars + description (``app/admin.py:19-21``)."""
+        df = self.repo_info
+        if df.empty:
+            return []
+        if q:
+            mask = df["repo_full_name"].fillna("").str.contains(q, case=False, regex=False)
+            if "repo_description" in df.columns:
+                mask |= df["repo_description"].fillna("").str.contains(q, case=False, regex=False)
+            df = df[mask]
+        cols = [
+            c for c in ("repo_id", "repo_full_name", "repo_language",
+                        "repo_stargazers_count", "repo_description")
+            if c in df.columns
+        ]
+        return json.loads(df[cols].head(limit).to_json(orient="records"))
+
+    def search_users(self, q: str = "", limit: int = 20) -> list[dict]:
+        """UserInfoAdmin parity: search login/name/company, list name/company/
+        location/bio (``app/admin.py:11-13``)."""
+        df = self.user_info
+        if df.empty:
+            return []
+        if q:
+            mask = pd.Series(False, index=df.index)
+            for col in ("user_login", "user_name", "user_company"):
+                if col in df.columns:
+                    mask |= df[col].fillna("").str.contains(q, case=False, regex=False)
+            df = df[mask]
+        cols = [
+            c for c in ("user_id", "user_login", "user_name", "user_company",
+                        "user_location", "user_bio")
+            if c in df.columns
+        ]
+        return json.loads(df[cols].head(limit).to_json(orient="records"))
+
+
+def _make_handler(service: RecommendationService):
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *args):  # quiet by default
+            pass
+
+        def _send(self, code: int, body: bytes, ctype: str) -> None:
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _json(self, obj, code: int = 200) -> None:
+            self._send(code, json.dumps(obj).encode(), "application/json")
+
+        def do_GET(self):  # noqa: N802 — http.server API
+            url = urlparse(self.path)
+            q = parse_qs(url.query)
+            parts = [p for p in url.path.split("/") if p]
+            try:
+                if not parts:
+                    self._send(200, _INDEX_HTML.encode(), "text/html")
+                elif parts[0] == "healthz":
+                    self._json({"ok": True})
+                elif parts[0] == "recommend" and len(parts) == 2:
+                    out = service.recommend(
+                        int(parts[1]),
+                        k=int(q.get("k", ["30"])[0]),
+                        exclude_seen=q.get("exclude_seen", ["1"])[0] != "0",
+                    )
+                    self._json(out, code=404 if out.get("error") else 200)
+                elif parts[:2] == ["admin", "repos"]:
+                    self._json(service.search_repos(
+                        q.get("q", [""])[0], int(q.get("limit", ["20"])[0])))
+                elif parts[:2] == ["admin", "users"]:
+                    self._json(service.search_users(
+                        q.get("q", [""])[0], int(q.get("limit", ["20"])[0])))
+                else:
+                    self._json({"error": "not found"}, code=404)
+            except (ValueError, KeyError) as e:
+                self._json({"error": str(e)}, code=400)
+
+    return Handler
+
+
+def serve(service: RecommendationService, host: str = "127.0.0.1", port: int = 8080):
+    """Start the server; returns it (call ``shutdown()`` to stop). Port 0
+    picks a free port (``server.server_address[1]``)."""
+    server = ThreadingHTTPServer((host, port), _make_handler(service))
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    return server
