@@ -105,13 +105,37 @@ class ImplicitALS:
     def device_groups(self, matrix: StarMatrix) -> tuple[list[tuple], list[tuple]]:
         """Stacked same-shape groups on device, as ``als_fit_fused`` consumes
         them — shared by ``fit`` and the bench's phase breakdown so both always
-        measure the same shapes."""
+        measure the same shapes.
+
+        With ``self.mesh`` set, each group's batch axis is laid out sharded
+        over the mesh's data axis (buckets padded to a device-count multiple):
+        the fused fit then runs under XLA's SPMD partitioner, which splits the
+        per-row solves across devices and inserts the all-gather when solved
+        rows scatter into the replicated factor tables — the compiler-inserted
+        version of ``parallel.als.ShardedALSSweep``'s explicit shard_map.
+        """
         user_buckets, item_buckets = self._host_buckets(matrix)
-        ug = [device_bucket(g) for g in group_buckets(user_buckets)]
-        ig = [device_bucket(g) for g in group_buckets(item_buckets)]
+        sharding = None
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from albedo_tpu.parallel.als import pad_bucket
+            from albedo_tpu.parallel.mesh import DATA_AXIS
+
+            n_dev = self.mesh.shape[DATA_AXIS]
+            user_buckets = [pad_bucket(b, n_dev) for b in user_buckets]
+            item_buckets = [pad_bucket(b, n_dev) for b in item_buckets]
+            # Leading axis = stacked same-shape buckets; batch axis sharded
+            # (specs shorter than the rank replicate trailing dims).
+            sharding = NamedSharding(self.mesh, P(None, DATA_AXIS))
+
+        def put(g):
+            d = device_bucket(g, sharding)
+            return (d.row_ids, d.idx, d.val, d.mask)
+
         return (
-            [(g.row_ids, g.idx, g.val, g.mask) for g in ug],
-            [(g.row_ids, g.idx, g.val, g.mask) for g in ig],
+            [put(g) for g in group_buckets(user_buckets)],
+            [put(g) for g in group_buckets(item_buckets)],
         )
 
     def fit(self, matrix: StarMatrix, callback: Any | None = None) -> ALSModel:
@@ -127,37 +151,29 @@ class ImplicitALS:
         user_f = jax.random.normal(ukey, (matrix.n_users, self.rank), jnp.float32) * scale
         item_f = jax.random.normal(ikey, (matrix.n_items, self.rank), jnp.float32) * scale
 
+        # Stack same-shape buckets and upload once (mesh: batch-axis sharded,
+        # GSPMD-partitioned solves); the whole max_iter loop then runs as a
+        # single fused dispatch (``ops.als.als_fit_fused``).
+        ug, ig = self.device_groups(matrix)
         if self.mesh is not None:
-            from albedo_tpu.parallel.als import ShardedALSSweep
+            from albedo_tpu.parallel.mesh import replicated
 
-            user_buckets, item_buckets = self._host_buckets(matrix)
-            sweep = ShardedALSSweep(self.mesh)
-            user_buckets = sweep.prepare(user_buckets)
-            item_buckets = sweep.prepare(item_buckets)
-            for it in range(self.max_iter):
-                # MLlib order: item factors first (from user factors), then users.
-                item_f = sweep.half_sweep(user_f, item_f, item_buckets, self.reg_param, self.alpha)
-                user_f = sweep.half_sweep(item_f, user_f, user_buckets, self.reg_param, self.alpha)
-                if callback is not None:
-                    callback(it, np.asarray(user_f), np.asarray(item_f))
+            user_f = jax.device_put(user_f, replicated(self.mesh))
+            item_f = jax.device_put(item_f, replicated(self.mesh))
+        reg = jnp.float32(self.reg_param)
+        alpha = jnp.float32(self.alpha)
+        if callback is None:
+            user_f, item_f = als_fit_fused(
+                user_f, item_f, ug, ig, reg, alpha, jnp.int32(self.max_iter)
+            )
         else:
-            # Stack same-shape buckets and upload once; the whole max_iter loop
-            # then runs as a single fused dispatch (``ops.als.als_fit_fused``).
-            ug, ig = self.device_groups(matrix)
-            reg = jnp.float32(self.reg_param)
-            alpha = jnp.float32(self.alpha)
-            if callback is None:
+            # One fused dispatch per iteration (same executable: n_iter is
+            # traced), surfacing factors to the host for the callback.
+            for it in range(self.max_iter):
                 user_f, item_f = als_fit_fused(
-                    user_f, item_f, ug, ig, reg, alpha, jnp.int32(self.max_iter)
+                    user_f, item_f, ug, ig, reg, alpha, jnp.int32(1)
                 )
-            else:
-                # One fused dispatch per iteration (same executable: n_iter is
-                # traced), surfacing factors to the host for the callback.
-                for it in range(self.max_iter):
-                    user_f, item_f = als_fit_fused(
-                        user_f, item_f, ug, ig, reg, alpha, jnp.int32(1)
-                    )
-                    callback(it, np.asarray(user_f), np.asarray(item_f))
+                callback(it, np.asarray(user_f), np.asarray(item_f))
 
         return ALSModel(
             user_factors=np.asarray(user_f),

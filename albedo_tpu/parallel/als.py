@@ -111,8 +111,12 @@ def _local_bucket_solve(source, yty, row_ids, idx, val, mask, reg, alpha):
 class ShardedALSSweep:
     """Stateful wrapper: pre-pads buckets for a mesh and runs half-sweeps.
 
-    Drop-in for ``ops.als.als_half_sweep`` in ``ImplicitALS.fit`` when a mesh
-    is supplied.
+    The EXPLICIT shard_map variant of the sharded sweep, kept as the
+    spelled-out-collectives reference implementation (and covered by its own
+    parity test). ``ImplicitALS.fit`` itself now runs the fused single-dispatch
+    path with batch-axis-sharded bucket groups, letting XLA's SPMD partitioner
+    insert the equivalent collectives (``models/als.py device_groups``); both
+    share the per-bucket math in ``ops.als.bucket_solve_body``.
     """
 
     def __init__(self, mesh: Mesh, axis: str = DATA_AXIS):

@@ -72,6 +72,29 @@ def test_sharded_als_matches_single_device(mesh8):
     )
 
 
+def test_explicit_shard_map_sweep_matches_single_device(mesh8):
+    """ShardedALSSweep — the explicit shard_map reference variant (the fit
+    itself uses the GSPMD fused path) — must match the per-bucket sweep."""
+    from albedo_tpu.datasets import bucket_rows
+    from albedo_tpu.ops.als import als_half_sweep
+    from albedo_tpu.parallel.als import ShardedALSSweep
+
+    m = synthetic_stars(n_users=96, n_items=64, mean_stars=8, seed=2)
+    rng = np.random.default_rng(0)
+    user_f = rng.normal(0, 0.1, (m.n_users, 8)).astype(np.float32)
+    item_f = rng.normal(0, 0.1, (m.n_items, 8)).astype(np.float32)
+    buckets = bucket_rows(*m.csr(), batch_size=32)
+
+    expected = als_half_sweep(
+        jnp.asarray(item_f), jnp.asarray(user_f), buckets, 0.3, 10.0
+    )
+    sweep = ShardedALSSweep(mesh8)
+    got = sweep.half_sweep(
+        jnp.asarray(item_f), jnp.asarray(user_f), sweep.prepare(buckets), 0.3, 10.0
+    )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected), rtol=2e-3, atol=2e-4)
+
+
 @pytest.mark.parametrize("mesh_name", ["mesh8_item", "mesh_2d"])
 def test_sharded_topk_matches_single_device(mesh_name, rng, request):
     if mesh_name == "mesh8_item":
