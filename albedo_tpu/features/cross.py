@@ -10,7 +10,7 @@ from __future__ import annotations
 import numpy as np
 import pandas as pd
 
-from albedo_tpu.features.pipeline import Transformer
+from albedo_tpu.features.pipeline import Transformer, memo_map
 
 
 class UserRepoTransformer(Transformer):
@@ -27,19 +27,29 @@ class UserRepoTransformer(Transformer):
 
     def transform(self, df: pd.DataFrame) -> pd.DataFrame:
         self.require_cols(df, [self.repo_language_col, self.user_languages_col])
-        index_out = np.empty(len(df), dtype=np.int32)
-        count_out = np.empty(len(df), dtype=np.int32)
-        for r, (lang, recent) in enumerate(
-            zip(df[self.repo_language_col], df[self.user_languages_col])
-        ):
+
+        def compute(pair) -> tuple[int, int]:
+            lang, recent = pair
             lang = (lang or "").lower()
             recent = list(recent) if recent is not None else []
             try:
-                index_out[r] = recent.index(lang)
+                index = recent.index(lang)
             except ValueError:
-                index_out[r] = len(recent) + self.not_found_offset
-            count_out[r] = sum(1 for x in recent if x == lang)
+                index = len(recent) + self.not_found_offset
+            return index, sum(1 for x in recent if x == lang)
+
+        # (language, recent-list) pairs repeat once per (user, repo) row;
+        # memoize per distinct pair like the other per-document transforms.
+        results = memo_map(
+            zip(df[self.repo_language_col], df[self.user_languages_col]),
+            compute,
+            key=lambda p: (p[0], tuple(p[1]) if p[1] is not None else ()),
+        )
         out = df.copy()
-        out["repo_language_index_in_user_recent_repo_languages"] = index_out
-        out["repo_language_count_in_user_recent_repo_languages"] = count_out
+        out["repo_language_index_in_user_recent_repo_languages"] = np.fromiter(
+            (r[0] for r in results), dtype=np.int32, count=len(results)
+        )
+        out["repo_language_count_in_user_recent_repo_languages"] = np.fromiter(
+            (r[1] for r in results), dtype=np.int32, count=len(results)
+        )
         return out

@@ -186,12 +186,17 @@ class CountVectorizer(Estimator):
 
     def fit(self, df: pd.DataFrame) -> CountVectorizerModel:
         # min_df filters on DOCUMENT frequency; vocab order/truncation use
-        # total TERM frequency — Spark CountVectorizer semantics.
+        # total TERM frequency — Spark CountVectorizer semantics. Each ROW is
+        # a document (repeats count separately), so repeated docs are counted
+        # once with their multiplicity instead of re-walked per row.
+        doc_mult: Counter = Counter(tuple(words) for words in df[self.input_col])
         doc_freq: Counter = Counter()
         term_freq: Counter = Counter()
-        for words in df[self.input_col]:
-            doc_freq.update(set(words))
-            term_freq.update(words)
+        for doc, m in doc_mult.items():
+            for w in set(doc):
+                doc_freq[w] += m
+            for w in doc:
+                term_freq[w] += m
         terms = [
             (w, term_freq[w]) for w, c in doc_freq.items() if c >= self.min_df
         ]

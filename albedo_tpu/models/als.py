@@ -89,6 +89,9 @@ class ImplicitALS:
     # Optional jax.sharding.Mesh: shard each bucket's batch dim over the mesh's
     # "data" axis (albedo_tpu.parallel.als) instead of single-device sweeps.
     mesh: Any | None = None
+    # Optional (user_factors, item_factors) warm start — resume-from-checkpoint
+    # (utils.checkpoint.checkpointed_als_fit) instead of the seeded init.
+    init_factors: tuple | None = None
 
     def _host_buckets(self, matrix: StarMatrix) -> tuple[list, list]:
         """(user, item) bucket lists — the exact layouts ``fit`` trains on."""
@@ -145,11 +148,15 @@ class ImplicitALS:
         after each full sweep (host arrays; for monitoring/tests).
         """
 
-        key = jax.random.PRNGKey(self.seed)
-        ukey, ikey = jax.random.split(key)
-        scale = 1.0 / np.sqrt(self.rank)
-        user_f = jax.random.normal(ukey, (matrix.n_users, self.rank), jnp.float32) * scale
-        item_f = jax.random.normal(ikey, (matrix.n_items, self.rank), jnp.float32) * scale
+        if self.init_factors is not None:
+            user_f = jnp.asarray(self.init_factors[0], jnp.float32)
+            item_f = jnp.asarray(self.init_factors[1], jnp.float32)
+        else:
+            key = jax.random.PRNGKey(self.seed)
+            ukey, ikey = jax.random.split(key)
+            scale = 1.0 / np.sqrt(self.rank)
+            user_f = jax.random.normal(ukey, (matrix.n_users, self.rank), jnp.float32) * scale
+            item_f = jax.random.normal(ikey, (matrix.n_items, self.rank), jnp.float32) * scale
 
         # Stack same-shape buckets and upload once (mesh: batch-axis sharded,
         # GSPMD-partitioned solves); the whole max_iter loop then runs as a

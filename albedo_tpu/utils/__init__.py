@@ -1,13 +1,23 @@
 """Utility layer: profiling/timing harness and schema assertions."""
 
+from albedo_tpu.utils.checkpoint import (
+    StepCheckpointer,
+    checkpointed_als_fit,
+    restore_pytree,
+    save_pytree,
+)
 from albedo_tpu.utils.profiling import Timer, profiler_trace, timed, timing
 from albedo_tpu.utils.schema import assert_columns, equals_ignore_nullability
 
 __all__ = [
+    "StepCheckpointer",
     "Timer",
     "assert_columns",
+    "checkpointed_als_fit",
     "equals_ignore_nullability",
     "profiler_trace",
+    "restore_pytree",
+    "save_pytree",
     "timed",
     "timing",
 ]
