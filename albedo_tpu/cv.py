@@ -3,10 +3,13 @@
 Reference parity: ``ALSRecommenderCV.scala:16-102`` (2-fold ``CrossValidator``
 over a rank x regParam x alpha grid, scored by ``RankingEvaluator``) and
 ``LogisticRegressionRankerCV.scala:326-332`` (grid over instance-weight
-columns). Spark runs each (fold, params) fit serially on the cluster; here
-each fit already saturates the chip/mesh, so the driver loop is sequential by
-design and the sorted (params, mean metric) report matches the reference's
-printout (:94-99).
+columns). Spark runs each (fold, params) fit serially on the cluster; here a
+full ALS fit already saturates the chip/mesh (one fused dispatch per fit), so
+this driver loop stays sequential by design, and the sorted
+(params, mean metric) report matches the reference's printout (:94-99). The
+one grid that does NOT saturate the chip — the ranker's weight-column grid,
+which refits a shared featurized set — runs as a single vmapped solve instead
+(``LogisticRegression.fit_many``, used by the ``cv_lr`` job).
 """
 
 from __future__ import annotations

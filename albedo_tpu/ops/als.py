@@ -15,6 +15,16 @@ with per-block LAPACK Cholesky on executors. Here each half-sweep is a set of
 fixed-shape bucket solves: gather ``Y[idx] -> (B, L, k)``, one fused einsum for
 the Gramian correction, batched Cholesky, scatter back — all on the MXU, no
 shuffle. Buckets come from ``albedo_tpu.datasets.bucket_rows``.
+
+Why XLA HLO and not a hand-written Pallas kernel: the op mix here is exactly
+what XLA fuses well — a row gather feeding a batched contraction with static
+shapes. A Pallas version would have to issue one small DMA per gathered row
+(arbitrary-index row gathers don't tile; ~k*4 bytes per transfer, latency-
+bound), and the k=50 factor width sits far off the 128-lane VMEM tile, so a
+custom kernel loses to the compiler's gather+einsum fusion. Pallas pays off
+when fusion FAILS (e.g. data-dependent inner structure); everything in this
+sweep is fusion-friendly by construction — that is what the tier-packed
+fixed-shape bucket layout is for.
 """
 
 from __future__ import annotations

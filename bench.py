@@ -353,6 +353,8 @@ def peak_flops_for(device_kind: str, measured: float) -> tuple[float, str]:
 
 
 BASELINE_RANKER_TRAIN_S = 5700.0  # reference Makefile:209 — "1h35m" Dataproc job
+BASELINE_W2V_TRAIN_S = 2338.0     # reference Makefile:186 — "38m58s" Dataproc job
+BASELINE_PROFILES_S = 506.0       # reference Makefile:95,118 — 5m18s + 3m8s
 
 
 def ranker_bench() -> dict:
@@ -395,9 +397,18 @@ def ranker_bench() -> dict:
         ),
         tag=md5(f"bench-ranker-{n_users}-{n_items}-{mean_stars}")[:10],
     )
+    t0 = time.perf_counter()
     up, uc, rp, rc = ctx.profiles()
+    profiles_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
     als = ctx.als_model()
+    prep_als_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
     w2v = ctx.word2vec()
+    # Reference baselines for the prerequisites: profiles 5m18s + 3m8s,
+    # ALS 10m19s, Word2Vec 38m58s (Makefile:95,118,141,186). Cold-cache runs
+    # measure real training; artifact-cache hits show as ~0.
+    w2v_s = time.perf_counter() - t0
     lo, hi = ctx.star_range()
     star = ctx.tables().starring
     recs = [
@@ -429,6 +440,12 @@ def ranker_bench() -> dict:
         "auc": round(float(result.auc), 5),
         "ndcg30": None if result.ndcg is None else round(float(result.ndcg), 5),
         "prep_s": round(prep_s, 3),
+        "prep_profiles_s": round(profiles_s, 3),
+        "prep_als_s": round(prep_als_s, 3),
+        "prep_w2v_s": round(w2v_s, 3),
+        "profiles_baseline_s": BASELINE_PROFILES_S,
+        "als_baseline_s": BASELINE_ALS_TRAIN_S,
+        "w2v_baseline_s": BASELINE_W2V_TRAIN_S,
         "stages": stages,
         "host_s": round(sum(v for k, v in timer.totals.items() if k not in device_stages), 3),
         "device_s": round(sum(v for k, v in timer.totals.items() if k in device_stages), 3),
