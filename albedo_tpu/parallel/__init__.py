@@ -13,6 +13,7 @@ Spark driver/executor runtime (SURVEY.md sections 2.5, 7).
 from albedo_tpu.parallel.mesh import (  # noqa: F401
     DATA_AXIS,
     ITEM_AXIS,
+    init_distributed,
     make_mesh,
     pad_rows_to,
     replicated,

@@ -14,9 +14,15 @@ broadcast), configured externally via ``spark-submit`` flags (``Makefile:96-107`
 
 Collectives ride ICI within a slice (psum for Gramians/gradients, all_gather
 for top-k candidate merges), replacing Spark shuffle/broadcast/collect.
+Multi-HOST scaling (several processes, each owning a slice, DCN between them)
+goes through ``init_distributed`` + the same global mesh: jax's runtime routes
+intra-slice collectives over ICI and inter-slice segments over DCN, so the
+sharding code above this module is host-count-agnostic.
 """
 
 from __future__ import annotations
+
+import os
 
 import jax
 import numpy as np
@@ -24,6 +30,40 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 DATA_AXIS = "data"
 ITEM_AXIS = "item"
+
+
+def init_distributed(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> int:
+    """Join the multi-host world (the NCCL/MPI-backend analogue, SURVEY.md
+    section 2.5 'communication backend').
+
+    Single-process runs are a no-op returning 1. Multi-host runs call
+    ``jax.distributed.initialize`` — args come from the parameters or the
+    standard env (``JAX_COORDINATOR_ADDRESS``/``JAX_NUM_PROCESSES``/
+    ``JAX_PROCESS_ID``, as a Dataproc-style launcher would set, mirroring how
+    the reference's parallelism is configured by ``spark-submit`` flags rather
+    than in code). After this, ``jax.devices()`` spans every host and
+    ``make_mesh`` builds the global mesh.
+    """
+    coordinator_address = coordinator_address or os.environ.get("JAX_COORDINATOR_ADDRESS")
+    if num_processes is None:
+        env = os.environ.get("JAX_NUM_PROCESSES")
+        num_processes = int(env) if env else None
+    if process_id is None:
+        env = os.environ.get("JAX_PROCESS_ID")
+        process_id = int(env) if env else None
+
+    if not coordinator_address or not num_processes or num_processes <= 1:
+        return 1
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    return num_processes
 
 
 def make_mesh(

@@ -159,3 +159,15 @@ def test_sharded_word2vec_matches_single_device(mesh8):
     np.testing.assert_allclose(sharded.vectors, single.vectors, rtol=5e-3, atol=5e-4)
     # And the embeddings must be non-trivial (training actually happened).
     assert np.linalg.norm(single.vectors, axis=1).mean() > 0.01
+
+
+def test_init_distributed_single_process_noop(monkeypatch):
+    """Without a coordinator the helper is a no-op world of 1 (this process);
+    env-provided settings are read the way a multi-host launcher would set them."""
+    from albedo_tpu.parallel.mesh import init_distributed
+
+    monkeypatch.delenv("JAX_COORDINATOR_ADDRESS", raising=False)
+    assert init_distributed() == 1
+    monkeypatch.setenv("JAX_COORDINATOR_ADDRESS", "host:1234")
+    monkeypatch.setenv("JAX_NUM_PROCESSES", "1")
+    assert init_distributed() == 1  # single process: still a no-op
