@@ -41,6 +41,14 @@ def main(argv: list[str] | None = None) -> int:
     if args.job not in _JOBS:
         print(f"no such job: {args.job}", file=sys.stderr)
         return 2
+    # Join the multi-host world (launcher env-configured; single-process runs
+    # are a no-op) BEFORE any job touches jax.devices()/make_mesh, so meshes
+    # span every host's devices (parallel/mesh.py init_distributed).
+    from albedo_tpu.parallel.mesh import init_distributed
+
+    n_proc = init_distributed()
+    if n_proc > 1:
+        print(f"[cli] joined distributed world: {n_proc} processes")
     _JOBS[args.job](args)
     return 0
 

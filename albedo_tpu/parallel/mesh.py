@@ -56,8 +56,30 @@ def init_distributed(
         env = os.environ.get("JAX_PROCESS_ID")
         process_id = int(env) if env else None
 
-    if not coordinator_address or not num_processes or num_processes <= 1:
+    if num_processes is None:
+        if coordinator_address:
+            # A coordinator with no world size is a misconfigured launcher,
+            # not a single-host run.
+            raise ValueError(
+                "coordinator address set but no process count "
+                "(set JAX_NUM_PROCESSES or pass num_processes)"
+            )
         return 1
+    if num_processes <= 1:
+        return 1
+    # An explicitly multi-process config with missing pieces must FAIL, not
+    # silently run this worker as an independent single-host job while the
+    # rest of the world hangs at the barrier.
+    if not coordinator_address:
+        raise ValueError(
+            f"num_processes={num_processes} but no coordinator address "
+            "(set JAX_COORDINATOR_ADDRESS or pass coordinator_address)"
+        )
+    if process_id is None:
+        raise ValueError(
+            f"num_processes={num_processes} but no process id "
+            "(set JAX_PROCESS_ID or pass process_id)"
+        )
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes,

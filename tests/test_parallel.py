@@ -167,7 +167,21 @@ def test_init_distributed_single_process_noop(monkeypatch):
     from albedo_tpu.parallel.mesh import init_distributed
 
     monkeypatch.delenv("JAX_COORDINATOR_ADDRESS", raising=False)
+    monkeypatch.delenv("JAX_NUM_PROCESSES", raising=False)
+    monkeypatch.delenv("JAX_PROCESS_ID", raising=False)
     assert init_distributed() == 1
     monkeypatch.setenv("JAX_COORDINATOR_ADDRESS", "host:1234")
     monkeypatch.setenv("JAX_NUM_PROCESSES", "1")
     assert init_distributed() == 1  # single process: still a no-op
+    # Misconfigured multi-process worlds must fail loudly, not run this
+    # worker as an independent single-host job.
+    monkeypatch.setenv("JAX_NUM_PROCESSES", "4")
+    with pytest.raises(ValueError, match="process id"):
+        init_distributed()
+    monkeypatch.delenv("JAX_COORDINATOR_ADDRESS")
+    with pytest.raises(ValueError, match="coordinator address"):
+        init_distributed()
+    monkeypatch.delenv("JAX_NUM_PROCESSES")
+    monkeypatch.setenv("JAX_COORDINATOR_ADDRESS", "host:1234")
+    with pytest.raises(ValueError, match="process count"):
+        init_distributed()
