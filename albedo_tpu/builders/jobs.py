@@ -574,6 +574,30 @@ def collect_data_job(args) -> None:
     _report("collect_data", "requests", float(stats.requests), t0)
 
 
+@register_job("drop_data")
+def drop_data_job(args) -> None:
+    """``drop_data`` Django command parity: truncate the crawl store's tables
+    (``drop_data.py:11-13``). Extra flags: --db PATH, --yes (required)."""
+    from albedo_tpu.store import EntityStore
+
+    t0 = time.time()
+    extra = argparse.ArgumentParser()
+    extra.add_argument("--db", default="albedo-crawl.db")
+    extra.add_argument("--yes", action="store_true",
+                       help="required confirmation; refuses to truncate without it")
+    ns, _ = extra.parse_known_args(getattr(args, "_rest", []))
+    if not ns.yes:
+        import sys
+
+        print("[drop_data] refusing to truncate without --yes", file=sys.stderr)
+        return 3  # nonzero: automation must not mistake a refusal for success
+    with EntityStore(ns.db) as store:
+        before = store.counts()
+        store.drop_data()
+        print(f"[drop_data] truncated {before}")
+    _report("drop_data", "rows_dropped", float(sum(before.values())), t0)
+
+
 @register_job("sync_index")
 def sync_index_job(args) -> None:
     """``sync_data_to_es`` parity: build the content embedding index."""

@@ -49,8 +49,9 @@ def main(argv: list[str] | None = None) -> int:
     n_proc = init_distributed()
     if n_proc > 1:
         print(f"[cli] joined distributed world: {n_proc} processes")
-    _JOBS[args.job](args)
-    return 0
+    rc = _JOBS[args.job](args)
+    # Jobs may return an int exit code (e.g. drop_data's refusal); None = ok.
+    return int(rc) if isinstance(rc, int) else 0
 
 
 def _load_builders() -> None:

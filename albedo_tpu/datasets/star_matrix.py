@@ -122,6 +122,33 @@ class StarMatrix:
         return out
 
 
+def clean_by_counts(
+    matrix: "StarMatrix",
+    min_item_stargazers: int = 1,
+    max_item_stargazers: int = 50_000,
+    min_user_starred: int = 1,
+    max_user_starred: int = 50_000,
+) -> "StarMatrix":
+    """``DataCleaner`` parity (``albedo_toolkit/transformers.py:23-92``):
+    drop interactions of items whose stargazer count is outside
+    [min, max], THEN of users whose starred count (after the item filter) is
+    outside [min, max] — the same two chained inner joins, as vectorized
+    mask selects. The returned matrix is re-indexed over the SURVIVING
+    users/items only (cleaning must shrink the factor tables downstream
+    models allocate, not leave ghost vocabulary rows)."""
+    ic = matrix.item_counts()
+    keep = (ic >= min_item_stargazers) & (ic <= max_item_stargazers)
+    m1 = matrix.select(keep[matrix.cols])
+    uc = m1.user_counts()
+    keep_u = (uc >= min_user_starred) & (uc <= max_user_starred)
+    m2 = m1.select(keep_u[m1.rows])
+    return StarMatrix.from_interactions(
+        raw_users=m2.user_ids[m2.rows],
+        raw_items=m2.item_ids[m2.cols],
+        vals=m2.vals,
+    )
+
+
 def _lookup(vocab: np.ndarray, raw: np.ndarray) -> np.ndarray:
     raw = np.asarray(raw, dtype=np.int64)
     if vocab.shape[0] == 0:

@@ -32,6 +32,16 @@ DATA_AXIS = "data"
 ITEM_AXIS = "item"
 
 
+_PROCESS_ID_HINT_ENVS = (
+    "SLURM_PROCID", "OMPI_COMM_WORLD_RANK", "PMI_RANK",
+    "TPU_WORKER_ID", "CLOUD_TPU_TASK_ID",
+)
+
+
+def _has_process_id_hint() -> bool:
+    return any(os.environ.get(e) is not None for e in _PROCESS_ID_HINT_ENVS)
+
+
 def init_distributed(
     coordinator_address: str | None = None,
     num_processes: int | None = None,
@@ -75,10 +85,15 @@ def init_distributed(
             f"num_processes={num_processes} but no coordinator address "
             "(set JAX_COORDINATOR_ADDRESS or pass coordinator_address)"
         )
-    if process_id is None:
+    if process_id is None and not _has_process_id_hint():
+        # jax.distributed.initialize can auto-detect the process id from
+        # cluster envs (Slurm, Open MPI, TPU pod metadata); only refuse when
+        # neither an explicit id nor any auto-detect hint exists — otherwise
+        # the failure surfaces as an opaque deep-in-JAX RuntimeError.
         raise ValueError(
             f"num_processes={num_processes} but no process id "
-            "(set JAX_PROCESS_ID or pass process_id)"
+            "(set JAX_PROCESS_ID / pass process_id, or run under a launcher "
+            "JAX can auto-detect: Slurm, Open MPI, TPU pod)"
         )
     jax.distributed.initialize(
         coordinator_address=coordinator_address,

@@ -130,3 +130,32 @@ def test_synthetic_power_law_shape():
     top10 = np.sort(counts)[-10:].sum()
     assert top10 > 0.1 * m.nnz  # popularity skew exists
     assert (m.user_counts() >= 1).all()
+
+
+def test_clean_by_counts_chained_filters():
+    """DataCleaner parity: item range filter first, then user range filter
+    computed on the already-item-filtered interactions."""
+    from albedo_tpu.datasets import clean_by_counts
+
+    m = synthetic_stars(n_users=200, n_items=120, mean_stars=10, seed=12)
+    cleaned = clean_by_counts(
+        m, min_item_stargazers=3, max_item_stargazers=60,
+        min_user_starred=2, max_user_starred=40,
+    )
+    ic_orig = m.item_counts()
+    # The result is re-indexed over survivors only: map back to the original
+    # dense ids through the raw vocabularies.
+    orig_items = m.items_of(cleaned.item_ids[cleaned.cols])
+    assert ((ic_orig[orig_items] >= 3) & (ic_orig[orig_items] <= 60)).all()
+    # Every surviving user's count AFTER the item filter is in range.
+    item_ok = (ic_orig >= 3) & (ic_orig <= 60)
+    m1 = m.select(item_ok[m.cols])
+    uc_mid = m1.user_counts()
+    orig_users = m.users_of(cleaned.user_ids[np.unique(cleaned.rows)])
+    assert ((uc_mid[orig_users] >= 2) & (uc_mid[orig_users] <= 40)).all()
+    # Dropped something, and the vocabularies shrank with it (no ghost rows
+    # for downstream factor tables).
+    assert cleaned.nnz < m.nnz
+    assert cleaned.n_items < m.n_items
+    assert cleaned.n_items == np.unique(cleaned.cols).size
+    assert cleaned.n_users == np.unique(cleaned.rows).size

@@ -46,3 +46,24 @@ def test_word2vec_corpus_includes_user_and_repo_fields():
     lang_tokens = [t for t in tok.tokenize(str(tables.repo_info["repo_language"].iloc[0])) if t]
     assert any(t in corpus for t in login_tokens)
     assert any(t in corpus for t in lang_tokens)
+
+
+def test_drop_data_job_requires_confirmation(tmp_path):
+    """The drop_data job refuses without --yes and truncates with it
+    (drop_data.py:11-13 parity, plus a guard the reference lacks)."""
+    from albedo_tpu.cli import main
+    from albedo_tpu.store import EntityStore
+
+    db = tmp_path / "crawl.db"
+    with EntityStore(db) as store:
+        store.upsert_user({"id": 1, "login": "a"})
+        store.add_starring(1, 2)
+        store.commit()
+
+    assert main(["drop_data", "--db", str(db)]) == 3  # refused, nonzero exit
+    with EntityStore(db) as store:
+        assert store.counts()["app_repostarring"] == 1  # refused: intact
+
+    assert main(["drop_data", "--db", str(db), "--yes"]) == 0
+    with EntityStore(db) as store:
+        assert sum(store.counts().values()) == 0
