@@ -1,0 +1,24 @@
+# Launcher parity with the reference's Makefile targets (reference
+# Makefile:131-218 wraps spark-submit; here each target wraps the CLI).
+# Usage: make train_als [ARGS="--small --tables path/to/tables"]
+
+PY ?= python
+ARGS ?=
+
+JOBS = popularity curation content train_als cv_als build_user_profile \
+       build_repo_profile train_word2vec train_lr cv_lr item_cf user_cf \
+       tfidf_content ranking_mf collect_data drop_data sync_index serve play
+
+.PHONY: $(JOBS) test bench dryrun
+
+$(JOBS):
+	$(PY) -m albedo_tpu.cli $@ $(ARGS)
+
+test:
+	$(PY) -m pytest tests/ -q
+
+bench:
+	$(PY) bench.py
+
+dryrun:
+	$(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('ok')"

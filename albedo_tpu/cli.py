@@ -36,7 +36,18 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument(
         "--now", type=float, default=None, help="epoch seconds for date features"
     )
+    parser.add_argument(
+        "--platform",
+        default=None,
+        help="force a jax platform (e.g. 'cpu') — the laptop-mode switch "
+        "(reference RUN_WITH_INTELLIJ local master). Must run before any "
+        "backend use; works even when a sitecustomize pre-imported jax.",
+    )
     args, _rest = parser.parse_known_args(argv)
+    if args.platform:
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
     args._rest = _rest  # job-specific flags (e.g. collect_data --db/--token)
     if args.job not in _JOBS:
         print(f"no such job: {args.job}", file=sys.stderr)

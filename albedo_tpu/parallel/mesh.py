@@ -33,7 +33,9 @@ ITEM_AXIS = "item"
 
 
 _PROCESS_ID_HINT_ENVS = (
-    "SLURM_PROCID", "OMPI_COMM_WORLD_RANK", "PMI_RANK",
+    # Envs jax's cluster auto-detection actually keys off (Slurm, Open MPI,
+    # TPU pod metadata) — not every rank-ish variable a launcher might set.
+    "SLURM_PROCID", "OMPI_COMM_WORLD_RANK",
     "TPU_WORKER_ID", "CLOUD_TPU_TASK_ID",
 )
 

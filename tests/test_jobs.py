@@ -67,3 +67,26 @@ def test_drop_data_job_requires_confirmation(tmp_path):
     assert main(["drop_data", "--db", str(db), "--yes"]) == 0
     with EntityStore(db) as store:
         assert sum(store.counts().values()) == 0
+
+
+def test_cli_platform_flag(tmp_path, monkeypatch):
+    """--platform cpu pins the backend before any job code touches devices.
+
+    conftest already runs tests on CPU, so assert the MECHANISM: the flag must
+    route through jax.config.update BEFORE the job body executes."""
+    import jax
+
+    from albedo_tpu.cli import main
+
+    calls = []
+    real_update = jax.config.update
+    monkeypatch.setattr(
+        jax.config, "update", lambda k, v: (calls.append((k, v)), real_update(k, v))
+    )
+    monkeypatch.setenv("ALBEDO_DATA_DIR", str(tmp_path))
+    assert main(["popularity", "--small", "--platform", "cpu"]) == 0
+    assert ("jax_platforms", "cpu") in calls
+    # Without the flag, the CLI must not touch the platform config.
+    calls.clear()
+    assert main(["popularity", "--small"]) == 0
+    assert ("jax_platforms", "cpu") not in calls
