@@ -139,16 +139,17 @@ def bucket_rows(
         row_ids = np.full((b,), -1, dtype=np.int32)
 
         cap = pad_l if max_len is None else min(pad_l, max_len)
-        for slot, r in enumerate(chunk):
-            lo, hi = int(indptr[r]), int(indptr[r + 1])
-            take = hi - lo
-            if take > cap:  # keep the tail = most recent entries in insert order
-                lo = hi - cap
-                take = cap
-            row_ids[slot] = r
-            idx[slot, :take] = indices[lo:hi]
-            val[slot, :take] = vals[lo:hi]
-            mask[slot, :take] = True
+        # Vectorized slot fill (one scatter per bucket, no per-row Python):
+        # rows over cap keep their TAIL = most recent entries in insert order.
+        hi = indptr[chunk + 1].astype(np.int64)
+        take = np.minimum(hi - indptr[chunk].astype(np.int64), cap)
+        pos = segment_positions(take)
+        slot_of = np.repeat(np.arange(n_take), take)
+        flat = np.repeat(hi - take, take) + pos
+        row_ids[:n_take] = chunk
+        idx[slot_of, pos] = indices[flat]
+        val[slot_of, pos] = vals[flat]
+        mask[slot_of, pos] = True
         buckets.append(Bucket(row_ids=row_ids, idx=idx, val=val, mask=mask))
     return buckets
 

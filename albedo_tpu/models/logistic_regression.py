@@ -31,6 +31,13 @@ from albedo_tpu.ops.sparse_linear import (
     weighted_logloss,
 )
 
+# Inference logits as ONE dispatch with params/batch as ARGUMENTS. Eager
+# block_logits would pay one tunneled-backend round-trip per op (~70 ms each,
+# ~100 ops); closing over the batch inside a jit would bake it into the HLO as
+# a constant — at real scale that program blows past the remote compile
+# service's request-size limit (observed as HTTP 413).
+_block_logits_jit = jax.jit(block_logits)
+
 
 @dataclasses.dataclass
 class LogisticRegressionModel:
@@ -44,7 +51,7 @@ class LogisticRegressionModel:
     def decision_function(self, fm: FeatureMatrix) -> np.ndarray:
         batch = feature_batch(fm)
         return np.asarray(
-            block_logits(self.params, self.scales, batch, center=self.center)
+            _block_logits_jit(self.params, self.scales, batch, self.center)
         )
 
     def predict_proba(self, fm: FeatureMatrix) -> np.ndarray:
@@ -111,13 +118,19 @@ class LogisticRegression:
         params = init_params(fm)
         reg = float(self.reg_param)
 
-        def loss_fn(p):
-            return weighted_logloss(p, scales, batch, y, w, reg, center=center)
+        # The batch rides as a jit ARGUMENT (see _run_lbfgs): a closure would
+        # embed it as an HLO constant, which at real scale exceeds the remote
+        # compile service's request limit (HTTP 413 on the tunneled backend).
+        data = (batch, y, w)
+
+        def loss_fn(p, d):
+            b, yy, ww = d
+            return weighted_logloss(p, scales, b, yy, ww, reg, center=center)
 
         if self.solver == "lbfgs":
-            params, loss = _run_lbfgs(loss_fn, params, self.max_iter, self.tol)
+            params, loss = _run_lbfgs(loss_fn, params, data, self.max_iter, self.tol)
         elif self.solver == "adam":
-            params, loss = _run_adam(loss_fn, params, self.max_iter, self.learning_rate)
+            params, loss = _run_adam(loss_fn, params, data, self.max_iter, self.learning_rate)
         else:
             raise ValueError(f"unknown solver {self.solver!r}")
 
@@ -161,11 +174,13 @@ class LogisticRegression:
         params0 = init_params(fm)
         reg = float(self.reg_param)
 
-        def solve(w):
-            def loss_fn(p):
-                return weighted_logloss(p, scales, batch, y, w, reg, center=center)
+        def solve(w, data):
+            b, yy = data
 
-            return _run_lbfgs(loss_fn, params0, self.max_iter, self.tol)
+            def loss_fn(p):
+                return weighted_logloss(p, scales, b, yy, w, reg, center=center)
+
+            return _lbfgs_loop(loss_fn, params0, self.max_iter, self.tol)
 
         if grid_mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
@@ -181,7 +196,9 @@ class LogisticRegression:
         else:
             ws_dev = jnp.asarray(ws)
 
-        params, losses = jax.jit(jax.vmap(solve))(ws_dev)
+        # Grid axis vmapped; the shared featurized batch enters unbatched as an
+        # argument (in_axes=None), not as a baked-in constant.
+        params, losses = jax.jit(jax.vmap(solve, in_axes=(0, None)))(ws_dev, (batch, y))
         center_np = None if center is None else np.asarray(center)
         return [
             LogisticRegressionModel(
@@ -202,11 +219,13 @@ def _finite_tree(tree) -> jax.Array:
     return ok
 
 
-def _run_lbfgs(loss_fn, params: Params, max_iter: int, tol: float):
+def _lbfgs_loop(loss_fn, params: Params, max_iter: int, tol: float):
+    """Traceable L-BFGS while_loop (no jit of its own — callers jit or vmap
+    it). ``loss_fn`` takes params only; any data it uses must already be traced
+    values in the caller's scope, never host constants."""
     opt = optax.lbfgs()
     value_and_grad = optax.value_and_grad_from_state(loss_fn)
 
-    @jax.jit
     def run(params):
         state = opt.init(params)
 
@@ -249,20 +268,33 @@ def _run_lbfgs(loss_fn, params: Params, max_iter: int, tol: float):
     return run(params)
 
 
-def _run_adam(loss_fn, params: Params, max_iter: int, lr: float):
+def _run_lbfgs(loss_fn, params: Params, data, max_iter: int, tol: float):
+    """jit wrapper around ``_lbfgs_loop``: ``data`` (the feature batch pytree)
+    enters as an argument, so the HLO stays small — a closure would serialize
+    the whole batch as a constant into the compile request (HTTP 413 on the
+    tunneled TPU backend at real scale). ``loss_fn(params, data)``."""
+
+    @jax.jit
+    def run(params, data):
+        return _lbfgs_loop(lambda p: loss_fn(p, data), params, max_iter, tol)
+
+    return run(params, data)
+
+
+def _run_adam(loss_fn, params: Params, data, max_iter: int, lr: float):
     opt = optax.adam(lr)
 
     @jax.jit
-    def run(params):
+    def run(params, data):
         state = opt.init(params)
 
         def step(carry, _):
             params, state = carry
-            loss, grads = jax.value_and_grad(loss_fn)(params)
+            loss, grads = jax.value_and_grad(lambda p: loss_fn(p, data))(params)
             updates, state = opt.update(grads, state, params)
             return (optax.apply_updates(params, updates), state), loss
 
         (params, _), losses = jax.lax.scan(step, (params, state), None, length=max_iter)
         return params, losses[-1]
 
-    return run(params)
+    return run(params, data)

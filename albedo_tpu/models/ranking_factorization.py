@@ -141,17 +141,17 @@ class RankingFactorization:
         }
         opt = optax.adam(self.learning_rate)
 
-        def item_score(p, u_vec, items):
+        def item_score(p, g, u_vec, items):
             return (
                 jnp.einsum("bk,b...k->b...", u_vec, p["y"][items])
                 + p["b"][items]
-                + g_items[items] @ p["w"]
+                + g[items] @ p["w"]
             )
 
-        def loss_fn(p, u, i_pos, i_neg):
+        def loss_fn(p, g, u, i_pos, i_neg):
             u_vec = p["x"][u]                               # (B, k)
-            s_pos = item_score(p, u_vec, i_pos)             # (B,)
-            s_neg = item_score(p, u_vec, i_neg)             # (B, N)
+            s_pos = item_score(p, g, u_vec, i_pos)          # (B,)
+            s_neg = item_score(p, g, u_vec, i_neg)          # (B, N)
             diff = s_pos[:, None] - s_neg
             loss = -jax.nn.log_sigmoid(diff).mean()
             reg = self.reg * (
@@ -161,8 +161,10 @@ class RankingFactorization:
             )
             return loss + reg
 
+        # Side-feature table enters as an argument (not a baked-in HLO
+        # constant — see models/logistic_regression.py on the 413 failure mode).
         @jax.jit
-        def run(params, rows, cols, key):
+        def run(params, g, rows, cols, key):
             state = opt.init(params)
 
             def epoch(carry, ekey):
@@ -178,7 +180,7 @@ class RankingFactorization:
                 def step(carry, batch):
                     params, state = carry
                     u, i_pos, i_neg = batch
-                    loss, grads = jax.value_and_grad(loss_fn)(params, u, i_pos, i_neg)
+                    loss, grads = jax.value_and_grad(loss_fn)(params, g, u, i_pos, i_neg)
                     updates, state = opt.update(grads, state, params)
                     return (optax.apply_updates(params, updates), state), loss
 
@@ -191,7 +193,7 @@ class RankingFactorization:
             (params, _), epoch_losses = jax.lax.scan(epoch, (params, state), ekeys)
             return params, epoch_losses
 
-        params, losses = run(params, rows, cols, kshuf)
+        params, losses = run(params, g_items, rows, cols, kshuf)
         item_bias = np.asarray(params["b"]) + np.asarray(g_items @ params["w"])
         return RankingFactorizationModel(
             user_factors=np.asarray(params["x"]),
