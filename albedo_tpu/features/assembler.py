@@ -25,9 +25,36 @@ import dataclasses
 import numpy as np
 import pandas as pd
 
-from albedo_tpu.features.pipeline import Estimator, Transformer
+from albedo_tpu.datasets.ragged import segment_positions
+from albedo_tpu.features.pipeline import Estimator, Transformer, col_values
 
 VOCAB_ATTR = "albedo_vocab_size"  # df.attrs[VOCAB_ATTR][col] = size hint
+
+
+def _dedup_rows(*cols):
+    """(repr_index (N,), [distinct values per col]) keyed by object identity.
+
+    The memoized per-document transforms (``memo_map``) alias repeated
+    documents to the SAME result objects, so identity-dedup collapses a
+    row-set that repeats each user/repo document ~100x down to the distinct
+    documents; padding/stacking then runs once per distinct value and rows
+    are materialized by one vectorized gather. Non-aliased inputs still work
+    — every row is simply its own representative.
+    """
+    n = len(cols[0])
+    slot: dict = {}
+    rep = np.empty(n, dtype=np.int64)
+    uniq = tuple([] for _ in cols)
+    for r in range(n):
+        key = tuple(id(c[r]) for c in cols)
+        j = slot.get(key)
+        if j is None:
+            j = len(uniq[0])
+            slot[key] = j
+            for u, c in zip(uniq, cols):
+                u.append(c[r])
+        rep[r] = j
+    return rep, uniq
 
 
 def set_vocab_size(df: pd.DataFrame, col: str, size: int) -> None:
@@ -127,7 +154,11 @@ class FeatureAssemblerModel(Transformer):
             names.append(c)
         for c in self.vector_cols:
             self.require_cols(df, [c])
-            vecs = np.stack([np.asarray(v, dtype=np.float32) for v in df[c]]) if n else np.zeros((0, 0), np.float32)
+            if n:
+                rep, (uniq,) = _dedup_rows(col_values(df[c]))
+                vecs = np.stack([np.asarray(v, dtype=np.float32) for v in uniq])[rep]
+            else:
+                vecs = np.zeros((0, 0), np.float32)
             blocks.append(vecs)
             names.extend(f"{c}[{i}]" for i in range(vecs.shape[1]))
         dense = (
@@ -149,14 +180,26 @@ class FeatureAssemblerModel(Transformer):
             ic, vc = f"{c}__bag_idx", f"{c}__bag_val"
             self.require_cols(df, [ic, vc])
             pad = self.bag_pad[c]
-            idx = np.full((n, pad), -1, dtype=np.int32)
-            val = np.zeros((n, pad), dtype=np.float32)
-            for r, (iv, vv) in enumerate(zip(df[ic], df[vc])):
-                take = min(len(iv), pad)
-                idx[r, :take] = np.asarray(iv[:take], dtype=np.int32)
-                val[r, :take] = np.asarray(vv[:take], dtype=np.float32)
-            bag_idx[c] = idx
-            bag_val[c] = val
+            # Pad each DISTINCT bag once (identity dedup over the memoized
+            # per-document arrays), scatter flat, gather rows — no per-row
+            # Python assignment.
+            rep, (u_i, u_v) = _dedup_rows(col_values(df[ic]), col_values(df[vc]))
+            u = len(u_i)
+            lens = np.fromiter((min(len(a), pad) for a in u_i), np.int64, count=u)
+            idx = np.full((u, pad), -1, dtype=np.int32)
+            val = np.zeros((u, pad), dtype=np.float32)
+            if u and int(lens.sum()):
+                pos = segment_positions(lens)
+                rows = np.repeat(np.arange(u), lens)
+                idx[rows, pos] = np.concatenate(
+                    [np.asarray(a[:t], dtype=np.int32) for a, t in zip(u_i, lens)]
+                )
+                val[rows, pos] = np.concatenate(
+                    [np.asarray(a[:t], dtype=np.float32) for a, t in zip(u_v, lens)]
+                )
+            # -1 rows stay fully masked; real gathers happen on device.
+            bag_idx[c] = idx[rep]
+            bag_val[c] = val[rep]
 
         return FeatureMatrix(
             dense=dense,
@@ -207,10 +250,13 @@ class FeatureAssembler(Estimator):
             if size is None:
                 size = hints.get(c)
             if size is None:
-                mx = max((int(np.max(iv)) for iv in df[f"{c}__bag_idx"] if len(iv)), default=-1)
+                mx = max(
+                    (int(np.max(iv)) for iv in col_values(df[f"{c}__bag_idx"]) if len(iv)),
+                    default=-1,
+                )
                 size = mx + 1
             bag_sizes[c] = int(size)
-            longest = max((len(iv) for iv in df[f"{c}__bag_idx"]), default=1)
+            longest = max((len(iv) for iv in col_values(df[f"{c}__bag_idx"])), default=1)
             bag_pad[c] = min(self.max_bag_pad, _pow2_at_least(max(1, longest)))
         return FeatureAssemblerModel(
             self.dense_cols, self.vector_cols, cat_sizes, bag_sizes, bag_pad

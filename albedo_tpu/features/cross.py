@@ -10,7 +10,7 @@ from __future__ import annotations
 import numpy as np
 import pandas as pd
 
-from albedo_tpu.features.pipeline import Transformer, memo_map
+from albedo_tpu.features.pipeline import Transformer, col_values, memo_map
 
 
 class UserRepoTransformer(Transformer):
@@ -41,7 +41,10 @@ class UserRepoTransformer(Transformer):
         # (language, recent-list) pairs repeat once per (user, repo) row;
         # memoize per distinct pair like the other per-document transforms.
         results = memo_map(
-            zip(df[self.repo_language_col], df[self.user_languages_col]),
+            zip(
+                col_values(df[self.repo_language_col]),
+                col_values(df[self.user_languages_col]),
+            ),
             compute,
             key=lambda p: (p[0], tuple(p[1]) if p[1] is not None else ()),
         )

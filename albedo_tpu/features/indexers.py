@@ -18,7 +18,7 @@ import numpy as np
 import pandas as pd
 
 from albedo_tpu.features.assembler import set_vocab_size
-from albedo_tpu.features.pipeline import Estimator, Transformer
+from albedo_tpu.features.pipeline import Estimator, Transformer, col_values
 
 
 class StringIndexerModel(Transformer):
@@ -39,7 +39,7 @@ class StringIndexerModel(Transformer):
         self.require_cols(df, [self.input_col])
         unknown = len(self.labels)
         idx = np.fromiter(
-            (self._index.get(v, unknown) for v in df[self.input_col]),
+            (self._index.get(v, unknown) for v in col_values(df[self.input_col])),
             dtype=np.int64,
             count=len(df),
         )
@@ -98,5 +98,8 @@ class FrequencyBinnerModel(Transformer):
     def transform(self, df: pd.DataFrame) -> pd.DataFrame:
         self.require_cols(df, [self.input_col])
         out = df.copy()
-        out[self.output_col] = [v if v in self.keep else self.other for v in df[self.input_col]]
+        out[self.output_col] = [
+            v if v in self.keep else self.other
+            for v in col_values(df[self.input_col])
+        ]
         return out

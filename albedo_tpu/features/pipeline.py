@@ -56,8 +56,19 @@ class FuncTransformer(Transformer):
     def transform(self, df: pd.DataFrame) -> pd.DataFrame:
         self.require_cols(df, [self.input_col])
         out = df.copy()
-        out[self.output_col] = [self.func(v) for v in df[self.input_col]]
+        out[self.output_col] = [self.func(v) for v in col_values(df[self.input_col])]
         return out
+
+
+def col_values(values):
+    """A pandas column as a plain object ndarray for Python-speed iteration.
+
+    Arrow-backed columns box every element on ``Series.__iter__`` (measured
+    ~45 s of a 115 s ranker run at profile scale); one vectorized
+    ``to_numpy`` conversion up front makes the downstream per-row loops
+    cheap. Non-Series inputs pass through unchanged.
+    """
+    return values.to_numpy(dtype=object) if isinstance(values, pd.Series) else values
 
 
 def memo_map(values, func: Callable[[Any], T], key: Callable[[Any], Any] | None = None) -> list[T]:
@@ -74,7 +85,7 @@ def memo_map(values, func: Callable[[Any], T], key: Callable[[Any], Any] | None 
     cache: dict = {}
     out = []
     sentinel = object()
-    for v in values:
+    for v in col_values(values):
         k = v if key is None else key(v)
         got = cache.get(k, sentinel)
         if got is sentinel:

@@ -26,7 +26,7 @@ import numpy as np
 import pandas as pd
 
 from albedo_tpu.features.assembler import set_vocab_size
-from albedo_tpu.features.pipeline import Estimator, Transformer, memo_map
+from albedo_tpu.features.pipeline import Estimator, Transformer, col_values, memo_map
 
 _LANGUAGE_TOKENS = {"c", "r", "c++", "c#", "f#"}
 _RE_CJK_CHAR = re.compile("[぀-ゟ゠-ヿ㄀-ㄯ豈-﫿一-鿿]")
@@ -189,7 +189,9 @@ class CountVectorizer(Estimator):
         # total TERM frequency — Spark CountVectorizer semantics. Each ROW is
         # a document (repeats count separately), so repeated docs are counted
         # once with their multiplicity instead of re-walked per row.
-        doc_mult: Counter = Counter(tuple(words) for words in df[self.input_col])
+        doc_mult: Counter = Counter(
+            tuple(words) for words in col_values(df[self.input_col])
+        )
         doc_freq: Counter = Counter()
         term_freq: Counter = Counter()
         for doc, m in doc_mult.items():
@@ -217,7 +219,9 @@ class SnowballStemmer(Transformer):
     def transform(self, df: pd.DataFrame) -> pd.DataFrame:
         self.require_cols(df, [self.input_col])
         out = df.copy()
-        out[self.output_col] = [[porter_stem(w) for w in ws] for ws in df[self.input_col]]
+        out[self.output_col] = [
+            [porter_stem(w) for w in ws] for ws in col_values(df[self.input_col])
+        ]
         return out
 
 

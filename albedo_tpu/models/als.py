@@ -83,10 +83,16 @@ class ImplicitALS:
     alpha: float = 40.0
     max_iter: int = 26
     seed: int = 42
-    # Large batches: the bucketed Cholesky/solve is LATENCY-bound per scan
-    # step (~50 sequential panel updates regardless of batch), so fewer,
-    # wider buckets cut the sweep's serial depth almost linearly (measured
-    # r4: 0.34 s/iter of Cholesky at batch_size=1024 on the bench matrix).
+    # Normal-equation solver: "cholesky" = exact per-row solve, MLlib's
+    # algorithm (the parity reference); "cg" = matrix-free Jacobi-
+    # preconditioned conjugate gradient warm-started from the previous
+    # sweep's factors (``ops.als.bucket_cg_body``) — the fast path: XLA's
+    # batched small-matrix Cholesky runs at a few GF/s on TPU while the CG
+    # matvec is einsum-shaped MXU work; a few warm-started steps per
+    # half-sweep match the exact solve's held-out ranking quality (the
+    # ``implicit`` package's standard CG solver uses 3).
+    solver: str = "cholesky"
+    cg_steps: int = 3
     batch_size: int = 8192
     max_entries: int = 1 << 21  # B*L budget per bucket (gather memory bound)
     max_len: int | None = None
@@ -175,14 +181,16 @@ class ImplicitALS:
         alpha = jnp.float32(self.alpha)
         if callback is None:
             user_f, item_f = als_fit_fused(
-                user_f, item_f, ug, ig, reg, alpha, jnp.int32(self.max_iter)
+                user_f, item_f, ug, ig, reg, alpha, jnp.int32(self.max_iter),
+                solver=self.solver, cg_steps=self.cg_steps,
             )
         else:
             # One fused dispatch per iteration (same executable: n_iter is
             # traced), surfacing factors to the host for the callback.
             for it in range(self.max_iter):
                 user_f, item_f = als_fit_fused(
-                    user_f, item_f, ug, ig, reg, alpha, jnp.int32(1)
+                    user_f, item_f, ug, ig, reg, alpha, jnp.int32(1),
+                    solver=self.solver, cg_steps=self.cg_steps,
                 )
                 callback(it, np.asarray(user_f), np.asarray(item_f))
 

@@ -169,16 +169,24 @@ def start_watchdog() -> None:
     t.start()
 
 
-def als_fit_flops(matrix, rank: int, iters: int, batch_size: int, max_entries: int) -> dict:
+def als_fit_flops(
+    matrix, rank: int, iters: int, batch_size: int, max_entries: int,
+    solver: str = "cholesky", cg_steps: int = 3,
+) -> dict:
     """Analytic FLOPs the ALS fit executes, from the actual padded bucket
     shapes (what the device computes, padding included).
 
     Per half-sweep over buckets of shape (B, L) with k = rank:
+
+    cholesky:
       Gramian correction einsum blk,bl,blm->bkm : 2*B*L*k^2
       confidence scale + b-vector einsum        : ~3*B*L*k
       batched Cholesky                          : B*k^3/3
       two triangular solves                     : 2*B*k^2 * 2
-      YtY                                       : 2*n_source*k^2  (once per half)
+    cg (matrix-free, never forms the systems):
+      setup (b-vector, diag, initial residual)  : ~9*B*L*k + 2*B*k^2
+      per step (matvec + vector updates)        : ~4*B*L*k + 2*B*k^2 + 10*B*k
+    both: YtY 2*n_source*k^2 once per half-sweep.
     """
     from albedo_tpu.datasets.ragged import bucket_rows
 
@@ -193,8 +201,12 @@ def als_fit_flops(matrix, rank: int, iters: int, batch_size: int, max_entries: i
         for b in buckets:
             B, L = b.idx.shape
             padded_entries += B * L
-            per_iter += 2.0 * B * L * k * k + 3.0 * B * L * k
-            per_iter += B * (k**3) / 3.0 + 4.0 * B * k * k
+            if solver == "cg":
+                per_iter += 9.0 * B * L * k + 2.0 * B * k * k
+                per_iter += cg_steps * (4.0 * B * L * k + 2.0 * B * k * k + 10.0 * B * k)
+            else:
+                per_iter += 2.0 * B * L * k * k + 3.0 * B * L * k
+                per_iter += B * (k**3) / 3.0 + 4.0 * B * k * k
         per_iter += 2.0 * n_source * k * k
     return {
         "flops": per_iter * iters,
@@ -269,7 +281,7 @@ def phase_breakdown(jax, jnp, train, als, repeats: int = 4) -> dict:
     levels attribute time to each phase. A tiny accumulator-dependent
     perturbation of the source factors defeats XLA's loop-invariant hoisting.
     """
-    from albedo_tpu.ops.als import als_fit_fused, bucket_solve_body
+    from albedo_tpu.ops.als import als_fit_fused, bucket_cg_body, bucket_solve_body
 
     # The exact device-group layout the fit trains on (shared helper).
     user_groups, item_groups = als.device_groups(train)
@@ -295,6 +307,12 @@ def phase_breakdown(jax, jnp, train, als, repeats: int = 4) -> dict:
                     gathered = src[idx]
                     corr = jnp.einsum("blk,bl,blm->bkm", gathered, alpha * val, gathered)
                     a = a + corr.mean() + yty.mean()
+                elif als.solver == "cg":
+                    x0 = jnp.zeros((idx.shape[0], src.shape[1]), src.dtype)
+                    solved = bucket_cg_body(
+                        src, yty, idx, val, mask, x0, reg, alpha, als.cg_steps
+                    )
+                    a = a + solved.mean()
                 else:
                     solved = bucket_solve_body(src, yty, idx, val, mask, reg, alpha)
                     a = a + solved.mean()
@@ -316,29 +334,37 @@ def phase_breakdown(jax, jnp, train, als, repeats: int = 4) -> dict:
 
     out = {}
     uf, vf = jnp.asarray(uf0), jnp.asarray(vf0)
-    levels = []
-    for lvl in range(3):
+    levels = {}
+    # The Gramian-einsum level only exists on the cholesky path; CG never
+    # forms the (B, k, k) systems.
+    lvls = [0, 1, 2] if als.solver != "cg" else [0, 2]
+    for lvl in lvls:
         run = make_level(lvl)
         run(uf, vf).block_until_ready()  # compile
         t0 = time.perf_counter()
         run(uf, vf).block_until_ready()
-        levels.append((time.perf_counter() - t0) / repeats)
+        levels[lvl] = (time.perf_counter() - t0) / repeats
 
     ug, ig = user_groups, item_groups
     n_it = jnp.int32(repeats)
     # als_fit_fused donates its factor args: hand it fresh copies per call.
-    jax.block_until_ready(
-        als_fit_fused(jnp.asarray(uf0), jnp.asarray(vf0), ug, ig, reg, alpha, n_it)
-    )
+    def full_fit():
+        return als_fit_fused(
+            jnp.asarray(uf0), jnp.asarray(vf0), ug, ig, reg, alpha, n_it,
+            solver=als.solver, cg_steps=als.cg_steps,
+        )
+
+    jax.block_until_ready(full_fit())
     t0 = time.perf_counter()
-    jax.block_until_ready(
-        als_fit_fused(jnp.asarray(uf0), jnp.asarray(vf0), ug, ig, reg, alpha, n_it)
-    )
+    jax.block_until_ready(full_fit())
     full = (time.perf_counter() - t0) / repeats
 
     out["gather_s"] = round(levels[0], 5)
-    out["gramian_einsum_s"] = round(max(0.0, levels[1] - levels[0]), 5)
-    out["cholesky_solve_s"] = round(max(0.0, levels[2] - levels[1]), 5)
+    if 1 in levels:
+        out["gramian_einsum_s"] = round(max(0.0, levels[1] - levels[0]), 5)
+        out["solve_s"] = round(max(0.0, levels[2] - levels[1]), 5)
+    else:
+        out["solve_s"] = round(max(0.0, levels[2] - levels[0]), 5)
     out["scatter_s"] = round(max(0.0, full - levels[2]), 5)
     out["full_iteration_s"] = round(full, 5)
     return out
@@ -478,6 +504,11 @@ def main() -> None:
     n_items = int(os.environ.get("ALBEDO_BENCH_ITEMS", "20000"))
     max_iter = int(os.environ.get("ALBEDO_BENCH_ITERS", "26"))
     mean_stars = float(os.environ.get("ALBEDO_BENCH_MEAN_STARS", "60"))
+    # Headline trains with the fast warm-started-CG solver (quality-gated by
+    # the NDCG@30 check below and by tests/test_als.py CG-vs-Cholesky parity);
+    # set ALBEDO_BENCH_SOLVER=cholesky for the exact MLlib-parity solve.
+    solver = os.environ.get("ALBEDO_BENCH_SOLVER", "cg")
+    cg_steps = int(os.environ.get("ALBEDO_BENCH_CG_STEPS", "3"))
 
     try:
         matrix = synthetic_stars(
@@ -485,12 +516,18 @@ def main() -> None:
         )
         train, test = random_split_by_user(matrix, test_ratio=0.1, seed=42)
 
-        als = ImplicitALS(rank=50, reg_param=0.5, alpha=40.0, max_iter=max_iter, seed=42)
+        als = ImplicitALS(
+            rank=50, reg_param=0.5, alpha=40.0, max_iter=max_iter, seed=42,
+            solver=solver, cg_steps=cg_steps,
+        )
 
         # Warm-up: compile every bucket-shape kernel outside the timed region
         # (first XLA compile is tens of seconds; the reference's 619 s likewise
         # excludes JVM/Spark startup — Makefile wraps only the submitted job).
-        ImplicitALS(rank=50, reg_param=0.5, alpha=40.0, max_iter=1, seed=42).fit(train)
+        ImplicitALS(
+            rank=50, reg_param=0.5, alpha=40.0, max_iter=1, seed=42,
+            solver=solver, cg_steps=cg_steps,
+        ).fit(train)
 
         t0 = time.perf_counter()
         model = als.fit(train)  # returns host arrays, so this is fully synchronized
@@ -502,6 +539,7 @@ def main() -> None:
         flop = als_fit_flops(
             train, rank=als.rank, iters=als.max_iter,
             batch_size=als.batch_size, max_entries=als.max_entries,
+            solver=als.solver, cg_steps=als.cg_steps,
         )
         gemm_f32 = measured_gemm_flops_per_s(jnp, jax, jnp.float32)
         gemm_bf16 = measured_gemm_flops_per_s(jnp, jax, jnp.bfloat16)
@@ -533,7 +571,8 @@ def main() -> None:
     ranker_error = None
     if os.environ.get("ALBEDO_BENCH_RANKER", "1") != "0":
         print(json.dumps(als_record(train_s, ndcg, info, flop, mfu, peak_source,
-                                    gemm_f32, gemm_bf16, dispatch_s, phases, None)),
+                                    gemm_f32, gemm_bf16, dispatch_s, phases, None,
+                                    als.solver, als.cg_steps)),
               flush=True)
         try:
             print(json.dumps(ranker_bench()), flush=True)
@@ -543,14 +582,16 @@ def main() -> None:
     print(
         json.dumps(
             als_record(train_s, ndcg, info, flop, mfu, peak_source,
-                       gemm_f32, gemm_bf16, dispatch_s, phases, ranker_error)
+                       gemm_f32, gemm_bf16, dispatch_s, phases, ranker_error,
+                       als.solver, als.cg_steps)
         ),
         flush=True,
     )
 
 
 def als_record(train_s, ndcg, info, flop, mfu, peak_source,
-               gemm_f32, gemm_bf16, dispatch_s, phases, ranker_error) -> dict:
+               gemm_f32, gemm_bf16, dispatch_s, phases, ranker_error,
+               solver="cholesky", cg_steps=None) -> dict:
     """The flagship metric record (shared by the early emit and the final line)."""
     return {
         "metric": "als_train_wallclock_rank50_iter26",
@@ -561,6 +602,8 @@ def als_record(train_s, ndcg, info, flop, mfu, peak_source,
         "baseline_s": BASELINE_ALS_TRAIN_S,
         "platform": info.get("platform"),
         "device_kind": info.get("device_kind"),
+        "solver": solver,
+        "cg_steps": cg_steps if solver == "cg" else None,
         "mfu": round(mfu, 6),
         "mfu_peak_source": peak_source,
         "model_flops": round(flop["flops"]),

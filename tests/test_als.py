@@ -144,6 +144,56 @@ def test_recovers_planted_structure():
     assert auc_proxy > pop_auc, (auc_proxy, pop_auc)
 
 
+def test_cg_half_sweep_converges_to_exact_solve(small_matrix):
+    """With enough steps, warm-started CG reaches the Cholesky solution (CG on
+    a k-dim SPD system is exact in k steps up to float error)."""
+    from albedo_tpu.datasets.ragged import device_bucket, group_buckets
+    from albedo_tpu.ops.als import scan_half_sweep
+
+    m = small_matrix
+    rng = np.random.default_rng(2)
+    rank, reg, alpha = 8, 0.3, 10.0
+    user_f = jnp.asarray(rng.normal(0, 0.1, (m.n_users, rank)).astype(np.float32))
+    item_f = jnp.asarray(rng.normal(0, 0.1, (m.n_items, rank)).astype(np.float32))
+    groups = [
+        device_bucket(g) for g in group_buckets(bucket_rows(*m.csr(), batch_size=32))
+    ]
+    reg_a, alpha_a = jnp.float32(reg), jnp.float32(alpha)
+    exact = np.asarray(
+        scan_half_sweep(item_f, user_f, groups, reg_a, alpha_a, "cholesky")
+    )
+    got = np.asarray(
+        scan_half_sweep(item_f, user_f, groups, reg_a, alpha_a, "cg", cg_steps=16)
+    )
+    np.testing.assert_allclose(got, exact, rtol=5e-3, atol=5e-4)
+
+
+def test_cg_fit_quality_matches_cholesky(small_matrix):
+    """The fast path (3 warm-started CG steps/half-sweep) must land on the
+    same objective value as the exact solver after a full fit."""
+    m = small_matrix
+    kw = dict(rank=8, reg_param=0.5, alpha=10.0, max_iter=10, seed=1)
+    exact = ImplicitALS(**kw).fit(m)
+    fast = ImplicitALS(**kw, solver="cg").fit(m)
+
+    def loss(model):
+        return float(
+            implicit_loss(
+                jnp.asarray(model.user_factors), jnp.asarray(model.item_factors),
+                jnp.asarray(m.rows), jnp.asarray(m.cols), jnp.asarray(m.vals),
+                reg=0.5, alpha=10.0,
+            )
+        )
+
+    l_exact, l_fast = loss(exact), loss(fast)
+    assert l_fast <= l_exact * 1.01, (l_fast, l_exact)
+    # And the models agree on predictions, not just on the objective.
+    s_exact = exact.predict(m.rows, m.cols)
+    s_fast = fast.predict(m.rows, m.cols)
+    corr = float(np.corrcoef(s_exact, s_fast)[0, 1])
+    assert corr > 0.995, corr
+
+
 def test_model_roundtrip(small_matrix, tmp_path):
     model = ImplicitALS(rank=4, max_iter=1).fit(small_matrix)
     arrays = model.to_arrays()
