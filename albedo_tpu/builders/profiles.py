@@ -70,6 +70,47 @@ class FeatureColumns:
         return self.boolean + self.continuous + self.categorical + self.list_ + self.text
 
 
+def plain_columns(df: pd.DataFrame) -> pd.DataFrame:
+    """Materialize extension-backed columns as plain numpy-dtype columns.
+
+    Arrow-backed columns pay a boxed per-element cost in every downstream
+    merge ``take`` and Python iteration; the ranker merges each profile into
+    the row set several times (measured 3x faster merges with plain object
+    columns at bench scale). Numeric/bool extension columns become their
+    numpy equivalents; everything else becomes object.
+    """
+    out = df.copy()
+    for c in out.columns:
+        dt = out[c].dtype
+        if isinstance(dt, np.dtype):
+            continue
+        if pd.api.types.is_bool_dtype(dt):
+            out[c] = out[c].to_numpy(dtype=bool, na_value=False)
+        elif pd.api.types.is_integer_dtype(dt):
+            # Preserve missingness: nullable ints with NAs become float64/NaN
+            # (pandas' classic promotion) rather than a fake 0.
+            if out[c].isna().any():
+                out[c] = out[c].to_numpy(dtype=np.float64, na_value=np.nan)
+            else:
+                out[c] = out[c].to_numpy(dtype=np.int64)
+        elif pd.api.types.is_float_dtype(dt):
+            out[c] = out[c].to_numpy(dtype=np.float64, na_value=np.nan)
+        else:
+            arr = out[c].to_numpy(dtype=object)
+            # Arrow LIST columns box each element as an ndarray; keep the
+            # list-of-str semantics downstream code (and Spark parity) expects.
+            # Full scan, not a first-element sniff: a leading null must not
+            # skip conversion for the rest of the column.
+            if any(isinstance(v, np.ndarray) for v in arr):
+                fixed = np.empty(len(arr), dtype=object)
+                fixed[:] = [
+                    v.tolist() if isinstance(v, np.ndarray) else v for v in arr
+                ]
+                arr = fixed
+            out[c] = arr
+    return out
+
+
 def _contains_any(series: pd.Series, words: list[str]) -> np.ndarray:
     low = series.str.lower()
     hit = np.zeros(len(series), dtype=bool)
@@ -174,7 +215,9 @@ def build_user_profile(
         list_=["user_recent_repo_languages", "user_recent_repo_topics"],
         text=["user_clean_bio", "user_recent_repo_descriptions"],
     )
-    profile = u[["user_id", "user_login", *cols.all()]].reset_index(drop=True)
+    profile = plain_columns(
+        u[["user_id", "user_login", *cols.all()]].reset_index(drop=True)
+    )
     return profile, cols
 
 
@@ -269,8 +312,10 @@ def build_repo_profile(
         list_=["repo_clean_topics"],
         text=["repo_text"],
     )
-    profile = r[
-        ["repo_id", "repo_full_name", "repo_owner_id", "repo_created_at",
-         "repo_updated_at", "repo_pushed_at", *cols.all()]
-    ].reset_index(drop=True)
+    profile = plain_columns(
+        r[
+            ["repo_id", "repo_full_name", "repo_owner_id", "repo_created_at",
+             "repo_updated_at", "repo_pushed_at", *cols.all()]
+        ].reset_index(drop=True)
+    )
     return profile, cols
