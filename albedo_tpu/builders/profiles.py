@@ -85,6 +85,10 @@ def plain_columns(df: pd.DataFrame) -> pd.DataFrame:
         if isinstance(dt, np.dtype):
             continue
         if pd.api.types.is_bool_dtype(dt):
+            # NA -> False is intended for the profile flag columns: the
+            # reference imputes nulls to "" BEFORE computing its LIKE-based
+            # keyword flags (UserProfileBuilder.scala:60-66), so a missing
+            # source value is a False flag, not a missing flag.
             out[c] = out[c].to_numpy(dtype=bool, na_value=False)
         elif pd.api.types.is_integer_dtype(dt):
             # Preserve missingness: nullable ints with NAs become float64/NaN

@@ -193,6 +193,7 @@ def als_fit_flops(
     k = float(rank)
     per_iter = 0.0
     padded_entries = 0
+    padded_rows = 0
     for csx, n_source in (
         (matrix.csr(), matrix.n_items),   # user solves read item factors
         (matrix.csc(), matrix.n_users),   # item solves read user factors
@@ -201,6 +202,7 @@ def als_fit_flops(
         for b in buckets:
             B, L = b.idx.shape
             padded_entries += B * L
+            padded_rows += B
             if solver == "cg":
                 per_iter += 9.0 * B * L * k + 2.0 * B * k * k
                 per_iter += cg_steps * (4.0 * B * L * k + 2.0 * B * k * k + 10.0 * B * k)
@@ -215,6 +217,7 @@ def als_fit_flops(
         # buckets, once in the CSC item-solve buckets), so the honest padding
         # overhead is padded_entries / logical_entries — both per-iteration.
         "padded_entries": padded_entries,
+        "padded_rows": padded_rows,
         "logical_entries": 2 * int(matrix.nnz),
         "logical_nnz": int(matrix.nnz),
     }
@@ -254,6 +257,51 @@ def measured_gemm_flops_per_s(jnp, jax, dtype, n: int = GEMM_N, chain: int = GEM
         run(a, b).block_until_ready()
         best = min(best, time.perf_counter() - t0)
     return 2.0 * n**3 * chain / best
+
+
+def measured_hbm_gbps(jnp, jax, n_floats: int = 1 << 28, chain: int = 16) -> float:
+    """Achievable HBM streaming bandwidth: ``chain`` dependent elementwise
+    passes over a 1 GiB array inside one jitted scan (each step reads + writes
+    the full array; dispatch latency amortized as in the GEMM roofline).
+
+    The ALS sweep is BANDWIDTH-bound, not FLOP-bound — each CG matvec streams
+    the gathered (B, L, k) ratings blocks — so the honest roofline for it is
+    bytes/s, not the MXU TF/s that a dense-GEMM workload would get."""
+    x = jnp.ones((n_floats,), jnp.float32)
+
+    @jax.jit
+    def run(a):
+        def step(c, _):
+            return c * 1.0000001, None
+        out, _ = jax.lax.scan(step, a, length=chain)
+        return out
+
+    run(x).block_until_ready()
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        run(x).block_until_ready()
+        best = min(best, time.perf_counter() - t0)
+    return 2.0 * 4.0 * n_floats * chain / best / 1e9  # read + write per step
+
+
+def als_iter_bytes(flop: dict, rank: int, solver: str, cg_steps: int) -> float:
+    """Approximate HBM bytes one ALS iteration streams (the bandwidth-side
+    analogue of the FLOP model; gathered blocks dominate).
+
+    Per padded entry the gathered factor row is k floats. The CG path streams
+    the gathered block ~3x in setup (b-vector, diagonal, initial residual
+    matvec) and ~2x per step; the Cholesky path reads it ~3x (correction
+    einsum twice, b-vector) plus the (B, k, k) systems once."""
+    k = float(rank)
+    entries = float(flop["padded_entries"])
+    rows = float(flop.get("padded_rows", 0))
+    if solver == "cg":
+        passes = 3.0 + 2.0 * cg_steps
+        return passes * entries * k * 4.0
+    # cholesky: gathered block ~3 passes + the (B, k, k) systems ~3 passes
+    # (build, factorize, solve).
+    return 3.0 * entries * k * 4.0 + 3.0 * rows * k * k * 4.0
 
 
 def measured_dispatch_latency_s(jnp, jax) -> float:
@@ -543,6 +591,7 @@ def main() -> None:
         )
         gemm_f32 = measured_gemm_flops_per_s(jnp, jax, jnp.float32)
         gemm_bf16 = measured_gemm_flops_per_s(jnp, jax, jnp.bfloat16)
+        hbm_gbps = measured_hbm_gbps(jnp, jax)
         dispatch_s = measured_dispatch_latency_s(jnp, jax)
         peak, peak_source = peak_flops_for(info.get("device_kind", ""), gemm_bf16)
         mfu = flop["flops"] / (train_s * peak)
@@ -571,8 +620,8 @@ def main() -> None:
     ranker_error = None
     if os.environ.get("ALBEDO_BENCH_RANKER", "1") != "0":
         print(json.dumps(als_record(train_s, ndcg, info, flop, mfu, peak_source,
-                                    gemm_f32, gemm_bf16, dispatch_s, phases, None,
-                                    als.solver, als.cg_steps)),
+                                    gemm_f32, gemm_bf16, hbm_gbps, dispatch_s,
+                                    phases, None, als.solver, als.cg_steps, als.rank)),
               flush=True)
         try:
             print(json.dumps(ranker_bench()), flush=True)
@@ -582,17 +631,20 @@ def main() -> None:
     print(
         json.dumps(
             als_record(train_s, ndcg, info, flop, mfu, peak_source,
-                       gemm_f32, gemm_bf16, dispatch_s, phases, ranker_error,
-                       als.solver, als.cg_steps)
+                       gemm_f32, gemm_bf16, hbm_gbps, dispatch_s, phases,
+                       ranker_error, als.solver, als.cg_steps, als.rank)
         ),
         flush=True,
     )
 
 
 def als_record(train_s, ndcg, info, flop, mfu, peak_source,
-               gemm_f32, gemm_bf16, dispatch_s, phases, ranker_error,
-               solver="cholesky", cg_steps=None) -> dict:
+               gemm_f32, gemm_bf16, hbm_gbps, dispatch_s, phases, ranker_error,
+               solver="cholesky", cg_steps=None, rank=50) -> dict:
     """The flagship metric record (shared by the early emit and the final line)."""
+    bytes_per_iter = als_iter_bytes(flop, rank, solver, cg_steps or 0)
+    n_iters = flop["flops"] / max(flop["per_iter"], 1.0)
+    achieved_gbps = bytes_per_iter * n_iters / max(train_s, 1e-9) / 1e9
     return {
         "metric": "als_train_wallclock_rank50_iter26",
         "value": round(train_s, 3),
@@ -616,6 +668,10 @@ def als_record(train_s, ndcg, info, flop, mfu, peak_source,
         "logical_nnz": flop["logical_nnz"],
         "measured_gemm_tflops": round(gemm_f32 / 1e12, 2),
         "measured_gemm_tflops_bf16": round(gemm_bf16 / 1e12, 2),
+        "measured_hbm_gbps": round(hbm_gbps, 1),
+        "model_bytes_per_iter": round(bytes_per_iter),
+        "achieved_gbps": round(achieved_gbps, 1),
+        "vs_bandwidth_roofline": round(achieved_gbps / max(hbm_gbps, 1e-9), 4),
         "dispatch_latency_ms": round(dispatch_s * 1e3, 2),
         "achieved_tflops": round(flop["flops"] / train_s / 1e12, 4),
         "vs_measured_roofline": round(
