@@ -96,6 +96,14 @@ class JobContext:
             self._cache["matrix"] = self.tables().star_matrix()
         return self._cache["matrix"]
 
+    def als_solver(self) -> tuple[str, int]:
+        """(solver, cg_steps) from the CLI ``--solver``/``--cg-steps`` flags."""
+        steps = getattr(self.args, "cg_steps", None)
+        return (
+            getattr(self.args, "solver", "cholesky") or "cholesky",
+            3 if steps is None else int(steps),
+        )
+
     def star_range(self) -> tuple[int, int]:
         # The reference's popular/profile star windows assume GitHub-scale
         # counts; synthetic tables are smaller.
@@ -108,11 +116,15 @@ class JobContext:
 
         if self.small:
             rank, iters = 16, 8
+        solver, cg_steps = self.als_solver()
         key = f"alsModel-{rank}-{reg}-{alpha}-{iters}"
+        if solver != "cholesky":
+            key += f"-{solver}{cg_steps}"  # solver-tagged artifact, no mixups
 
         def train():
             return ImplicitALS(
-                rank=rank, reg_param=reg, alpha=alpha, max_iter=iters
+                rank=rank, reg_param=reg, alpha=alpha, max_iter=iters,
+                solver=solver, cg_steps=cg_steps,
             ).fit(self.matrix())
 
         if "als" not in self._cache:
@@ -296,8 +308,12 @@ def cv_als_job(args) -> None:
     )
     iters = 6 if ctx.small else 13
 
+    solver, cg_steps = ctx.als_solver()
+
     def fit(params, train):
-        return ImplicitALS(max_iter=iters, **params).fit(train)
+        return ImplicitALS(
+            max_iter=iters, solver=solver, cg_steps=cg_steps, **params
+        ).fit(train)
 
     def evaluate(model, train, test):
         users = sample_test_users(test, n=150)

@@ -37,6 +37,16 @@ def main(argv: list[str] | None = None) -> int:
         "--now", type=float, default=None, help="epoch seconds for date features"
     )
     parser.add_argument(
+        "--solver",
+        choices=("cholesky", "cg"),
+        default="cholesky",
+        help="ALS normal-equation solver: exact Cholesky (MLlib parity, "
+        "default) or matrix-free warm-started CG (fast path)",
+    )
+    parser.add_argument(
+        "--cg-steps", type=int, default=3, help="CG steps per half-sweep (--solver cg)"
+    )
+    parser.add_argument(
         "--platform",
         default=None,
         help="force a jax platform (e.g. 'cpu') — the laptop-mode switch "

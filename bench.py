@@ -621,7 +621,7 @@ def main() -> None:
     if os.environ.get("ALBEDO_BENCH_RANKER", "1") != "0":
         print(json.dumps(als_record(train_s, ndcg, info, flop, mfu, peak_source,
                                     gemm_f32, gemm_bf16, hbm_gbps, dispatch_s,
-                                    phases, None, als.solver, als.cg_steps, als.rank)),
+                                    phases, None, als.solver, als.cg_steps, als.rank, als.max_iter)),
               flush=True)
         try:
             print(json.dumps(ranker_bench()), flush=True)
@@ -632,7 +632,7 @@ def main() -> None:
         json.dumps(
             als_record(train_s, ndcg, info, flop, mfu, peak_source,
                        gemm_f32, gemm_bf16, hbm_gbps, dispatch_s, phases,
-                       ranker_error, als.solver, als.cg_steps, als.rank)
+                       ranker_error, als.solver, als.cg_steps, als.rank, als.max_iter)
         ),
         flush=True,
     )
@@ -640,10 +640,10 @@ def main() -> None:
 
 def als_record(train_s, ndcg, info, flop, mfu, peak_source,
                gemm_f32, gemm_bf16, hbm_gbps, dispatch_s, phases, ranker_error,
-               solver="cholesky", cg_steps=None, rank=50) -> dict:
+               solver="cholesky", cg_steps=None, rank=50, iters=26) -> dict:
     """The flagship metric record (shared by the early emit and the final line)."""
     bytes_per_iter = als_iter_bytes(flop, rank, solver, cg_steps or 0)
-    n_iters = flop["flops"] / max(flop["per_iter"], 1.0)
+    n_iters = float(iters)
     achieved_gbps = bytes_per_iter * n_iters / max(train_s, 1e-9) / 1e9
     return {
         "metric": "als_train_wallclock_rank50_iter26",

@@ -90,3 +90,28 @@ def test_cli_platform_flag(tmp_path, monkeypatch):
     calls.clear()
     assert main(["popularity", "--small"]) == 0
     assert ("jax_platforms", "cpu") not in calls
+
+
+def test_solver_flag_reaches_als(monkeypatch):
+    """--solver cg must flow from the CLI namespace into ImplicitALS and tag
+    the artifact key so cg/cholesky models never collide in the cache."""
+    seen = {}
+
+    from albedo_tpu.models import als as als_mod
+
+    class SpyALS(als_mod.ImplicitALS):
+        def fit(self, matrix, callback=None):
+            seen["solver"] = self.solver
+            seen["cg_steps"] = self.cg_steps
+            return super().fit(matrix, callback)
+
+    monkeypatch.setattr(als_mod, "ImplicitALS", SpyALS)
+    ctx = make_ctx(solver="cg", cg_steps=2)
+    ctx.als_model()
+    assert seen == {"solver": "cg", "cg_steps": 2}
+    # The cg-tagged artifact must actually exist on disk (cache-collision
+    # guard: cg and cholesky models write different keys).
+    from albedo_tpu.datasets.artifacts import artifact_path
+
+    tagged = artifact_path(ctx.artifact_name("alsModel-16-0.5-40.0-8-cg2.pkl"))
+    assert tagged.exists(), tagged
