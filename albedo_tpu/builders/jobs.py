@@ -181,19 +181,27 @@ class JobContext:
         )
         return list(staged["text__filtered"])
 
+    def word2vec_estimator(self):
+        """The configured (untrained) Word2Vec — also what the
+        ``train_word2vec`` job's explainParams dump prints."""
+        from albedo_tpu.models.word2vec import Word2Vec
+
+        dim, iters = (16, 3) if not getattr(self.args, "tables", None) or self.small else (200, 30)
+        return Word2Vec(
+            dim=dim, min_count=3 if self.small else 10, max_iter=iters, subsample=0.0
+        )
+
     def word2vec(self):
-        from albedo_tpu.models.word2vec import Word2Vec, Word2VecModel
+        from albedo_tpu.models.word2vec import Word2VecModel
 
         if "w2v" not in self._cache:
-            dim, iters = (16, 3) if not getattr(self.args, "tables", None) or self.small else (200, 30)
+            est = self.word2vec_estimator()
+            dim, iters = est.dim, est.max_iter
 
             def train():
                 # Corpus built lazily inside the closure: a cache hit on the
                 # trained model skips the full-table tokenization pass.
-                return Word2Vec(
-                    dim=dim, min_count=3 if self.small else 10, max_iter=iters,
-                    subsample=0.0,
-                ).fit_corpus(self.word2vec_corpus())
+                return est.fit_corpus(self.word2vec_corpus())
 
             arrays = load_or_create_pickle(
                 self.artifact_name(f"word2VecModel-v2-{dim}-{iters}.pkl"),
@@ -285,6 +293,9 @@ def train_als_job(args) -> None:
 
     t0 = time.time()
     ctx = JobContext(args)
+    # Sparsity print: the PySpark track's calculate_sparsity parity
+    # (albedo_toolkit/common.py).
+    print(f"[train_als] star-matrix sparsity = {ctx.matrix().sparsity():.6f}")
     model = ctx.als_model()
     rec = ALSRecommender(model, ctx.matrix(), top_k=TOP_K)
     users = ctx.matrix().user_ids[ctx.test_user_dense()]
@@ -362,9 +373,12 @@ def build_repo_profile_job(args) -> None:
 
 @register_job("train_word2vec")
 def train_word2vec_job(args) -> None:
-    """``Word2VecCorpusBuilder``."""
+    """``Word2VecCorpusBuilder`` (explainParams dump parity, :85)."""
+    from albedo_tpu.utils.params import explain_params
+
     t0 = time.time()
     ctx = JobContext(args)
+    print(f"[train_word2vec] {explain_params(ctx.word2vec_estimator())}")
     model = ctx.word2vec()
     _report("train_word2vec", "vocab", float(len(model.vocab)), t0)
 

@@ -54,6 +54,11 @@ def main(argv: list[str] | None = None) -> int:
         "backend use; works even when a sitecustomize pre-imported jax.",
     )
     args, _rest = parser.parse_known_args(argv)
+    # log4j.properties analogue: WARN root / quiet backends / app at INFO
+    # (ALBEDO_LOG_LEVEL overrides).
+    from albedo_tpu.utils.log import configure_logging
+
+    configure_logging()
     if args.platform:
         import jax
 

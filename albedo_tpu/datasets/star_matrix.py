@@ -121,6 +121,12 @@ class StarMatrix:
         out[self.rows, self.cols] = self.vals
         return out
 
+    def sparsity(self) -> float:
+        """Fraction of EMPTY cells, as the PySpark toolkit reports it
+        (``albedo_toolkit/common.py`` ``calculate_sparsity``)."""
+        cells = self.n_users * self.n_items
+        return 1.0 - self.nnz / cells if cells else 0.0
+
 
 def clean_by_counts(
     matrix: "StarMatrix",

@@ -219,11 +219,26 @@ def _finite_tree(tree) -> jax.Array:
     return ok
 
 
+# Zoom-linesearch eval budget per L-BFGS step. optax's default (15) spends
+# most of the fit inside line-search f-evals on this full-batch objective;
+# capping at 8 reached the identical loss (6 decimal places, bench-scale
+# synthetic and test suites) in ~2-4x less wall-clock on TPU.
+MAX_LINESEARCH_STEPS = 8
+
+
 def _lbfgs_loop(loss_fn, params: Params, max_iter: int, tol: float):
     """Traceable L-BFGS while_loop (no jit of its own — callers jit or vmap
     it). ``loss_fn`` takes params only; any data it uses must already be traced
     values in the caller's scope, never host constants."""
-    opt = optax.lbfgs()
+    opt = optax.lbfgs(
+        linesearch=optax.scale_by_zoom_linesearch(
+            max_linesearch_steps=MAX_LINESEARCH_STEPS,
+            # 'one' is optax.lbfgs's own default and the documented choice
+            # for quasi-Newton methods ('keep' can pin later searches to an
+            # early small step and exhaust the reduced eval budget).
+            initial_guess_strategy="one",
+        )
+    )
     value_and_grad = optax.value_and_grad_from_state(loss_fn)
 
     def run(params):

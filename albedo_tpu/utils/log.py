@@ -1,0 +1,33 @@
+"""Logging configuration.
+
+Reference parity: ``log4j.properties`` — WARN-level root so Spark internals
+stay quiet, with the app package at INFO (``log4j.properties:1-27``). The JAX
+analogue quiets the backend/compiler loggers and keeps ``albedo_tpu`` at INFO;
+``ALBEDO_LOG_LEVEL`` overrides the app level (the env tier of the reference's
+three-tier config system, SURVEY.md §5).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+_CONFIGURED = False
+
+
+def configure_logging(app_level: str | None = None) -> logging.Logger:
+    """Idempotent: root WARN, noisy backend loggers WARN, app at INFO (or
+    ``ALBEDO_LOG_LEVEL``). Returns the app logger."""
+    global _CONFIGURED
+    level_name = (app_level or os.environ.get("ALBEDO_LOG_LEVEL", "INFO")).upper()
+    app = logging.getLogger("albedo_tpu")
+    if not _CONFIGURED:
+        logging.basicConfig(
+            level=logging.WARNING,
+            format="%(levelname)s:%(asctime)s:%(name)s: %(message)s",
+        )
+        for noisy in ("jax", "jax._src", "absl", "urllib3"):
+            logging.getLogger(noisy).setLevel(logging.WARNING)
+        _CONFIGURED = True
+    app.setLevel(getattr(logging, level_name, logging.INFO))
+    return app

@@ -1,0 +1,29 @@
+"""Estimator parameter dumps.
+
+Reference parity: Spark's ``explainParams`` printed before expensive fits
+(``Word2VecCorpusBuilder.scala:85``) so the exact hyperparameters of a run are
+in its log. Estimators here are dataclasses, so the dump is their fields.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+
+def explain_params(estimator: Any) -> str:
+    """``name: field=value, ...`` over dataclass fields (non-dataclasses fall
+    back to their public ``__dict__``), skipping unset/None infrastructure
+    fields like ``mesh``."""
+    name = type(estimator).__name__
+    if dataclasses.is_dataclass(estimator):
+        pairs = [
+            (f.name, getattr(estimator, f.name))
+            for f in dataclasses.fields(estimator)
+        ]
+    else:
+        pairs = [
+            (k, v) for k, v in vars(estimator).items() if not k.startswith("_")
+        ]
+    body = ", ".join(f"{k}={v!r}" for k, v in pairs if v is not None)
+    return f"{name}({body})"

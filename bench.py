@@ -463,13 +463,23 @@ def ranker_bench() -> dict:
     n_items = int(os.environ.get("ALBEDO_BENCH_RANKER_ITEMS", "5000"))
     mean_stars = float(os.environ.get("ALBEDO_BENCH_RANKER_MEAN_STARS", "20"))
 
+    tag = md5(f"bench-ranker-{n_users}-{n_items}-{mean_stars}")[:10]
+    # Cold prerequisites by default: drop this bench's cached artifacts so
+    # prep_profiles_s / prep_als_s / prep_w2v_s measure real training against
+    # their Makefile baselines on every run, not a same-day cache hit.
+    if os.environ.get("ALBEDO_BENCH_COLD_PREP", "1") != "0":
+        from albedo_tpu.settings import get_settings
+
+        for p in get_settings().artifact_dir.glob(f"{tag}-*"):
+            p.unlink()
+
     t_prep = time.perf_counter()
     ctx = JobContext(
         argparse.Namespace(small=False, tables=None),
         tables=synthetic_tables(
             n_users=n_users, n_items=n_items, mean_stars=mean_stars, seed=42
         ),
-        tag=md5(f"bench-ranker-{n_users}-{n_items}-{mean_stars}")[:10],
+        tag=tag,
     )
     t0 = time.perf_counter()
     up, uc, rp, rc = ctx.profiles()

@@ -159,3 +159,17 @@ def test_clean_by_counts_chained_filters():
     assert cleaned.n_items < m.n_items
     assert cleaned.n_items == np.unique(cleaned.cols).size
     assert cleaned.n_users == np.unique(cleaned.rows).size
+
+
+def test_sparsity():
+    from albedo_tpu.datasets import StarMatrix
+
+    m = StarMatrix(
+        user_ids=np.array([1, 2]),
+        item_ids=np.array([10, 20]),
+        rows=np.array([0, 1], dtype=np.int32),
+        cols=np.array([0, 1], dtype=np.int32),
+        vals=np.ones(2, dtype=np.float32),
+    )
+    # 2 of 4 cells filled -> sparsity 0.5 (albedo_toolkit calculate_sparsity).
+    assert m.sparsity() == 0.5

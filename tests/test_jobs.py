@@ -115,3 +115,14 @@ def test_solver_flag_reaches_als(monkeypatch):
 
     tagged = artifact_path(ctx.artifact_name("alsModel-16-0.5-40.0-8-cg2.pkl"))
     assert tagged.exists(), tagged
+
+
+def test_word2vec_explain_params_dump(capsys):
+    """train_word2vec prints the estimator's hyperparameters before fitting
+    (Word2VecCorpusBuilder.scala:85 explainParams parity)."""
+    from albedo_tpu.builders.jobs import train_word2vec_job
+
+    train_word2vec_job(make_ctx().args)
+    out = capsys.readouterr().out
+    assert "[train_word2vec] Word2Vec(" in out
+    assert "dim=16" in out and "max_iter=3" in out
