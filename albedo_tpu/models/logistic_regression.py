@@ -219,7 +219,7 @@ def _finite_tree(tree) -> jax.Array:
     return ok
 
 
-# Zoom-linesearch eval budget per L-BFGS step. optax's default (15) spends
+# Zoom-linesearch eval budget per L-BFGS step. optax's default (20) spends
 # most of the fit inside line-search f-evals on this full-batch objective;
 # capping at 8 reached the identical loss (6 decimal places, bench-scale
 # synthetic and test suites) in ~2-4x less wall-clock on TPU.

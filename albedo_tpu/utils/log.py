@@ -20,6 +20,10 @@ def configure_logging(app_level: str | None = None) -> logging.Logger:
     ``ALBEDO_LOG_LEVEL``). Returns the app logger."""
     global _CONFIGURED
     level_name = (app_level or os.environ.get("ALBEDO_LOG_LEVEL", "INFO")).upper()
+    levels = logging.getLevelNamesMapping()
+    if level_name not in levels:
+        print(f"warning: unknown ALBEDO_LOG_LEVEL {level_name!r}, using INFO")
+        level_name = "INFO"
     app = logging.getLogger("albedo_tpu")
     if not _CONFIGURED:
         logging.basicConfig(
@@ -29,5 +33,5 @@ def configure_logging(app_level: str | None = None) -> logging.Logger:
         for noisy in ("jax", "jax._src", "absl", "urllib3"):
             logging.getLogger(noisy).setLevel(logging.WARNING)
         _CONFIGURED = True
-    app.setLevel(getattr(logging, level_name, logging.INFO))
+    app.setLevel(levels[level_name])
     return app

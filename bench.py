@@ -471,11 +471,14 @@ def ranker_bench() -> dict:
         from albedo_tpu.settings import get_settings
 
         for p in get_settings().artifact_dir.glob(f"{tag}-*"):
-            p.unlink()
+            p.unlink(missing_ok=True)  # race-safe vs a concurrent bench
 
     t_prep = time.perf_counter()
+    # w2v_full: train the Word2Vec prerequisite at the REFERENCE config
+    # (dim=200, 30 epochs) so prep_w2v_s compares honestly against the
+    # 38m58s baseline (~31 s measured on a v5e).
     ctx = JobContext(
-        argparse.Namespace(small=False, tables=None),
+        argparse.Namespace(small=False, tables=None, w2v_full=True),
         tables=synthetic_tables(
             n_users=n_users, n_items=n_items, mean_stars=mean_stars, seed=42
         ),
