@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import logging
 import os
+import sys
 
 _CONFIGURED = False
 
@@ -20,9 +21,18 @@ def configure_logging(app_level: str | None = None) -> logging.Logger:
     ``ALBEDO_LOG_LEVEL``). Returns the app logger."""
     global _CONFIGURED
     level_name = (app_level or os.environ.get("ALBEDO_LOG_LEVEL", "INFO")).upper()
-    levels = logging.getLevelNamesMapping()
+    # Literal map, not logging.getLevelNamesMapping() (3.11+ only; pyproject
+    # supports 3.10).
+    levels = {
+        "CRITICAL": logging.CRITICAL, "ERROR": logging.ERROR,
+        "WARNING": logging.WARNING, "WARN": logging.WARNING,
+        "INFO": logging.INFO, "DEBUG": logging.DEBUG, "NOTSET": logging.NOTSET,
+    }
     if level_name not in levels:
-        print(f"warning: unknown ALBEDO_LOG_LEVEL {level_name!r}, using INFO")
+        print(
+            f"warning: unknown ALBEDO_LOG_LEVEL {level_name!r}, using INFO",
+            file=sys.stderr,
+        )
         level_name = "INFO"
     app = logging.getLogger("albedo_tpu")
     if not _CONFIGURED:
