@@ -47,6 +47,9 @@ class LogisticRegressionModel:
     # Dense-block means subtracted before scaling (None = uncentered). See
     # ops.sparse_linear.dense_center for why centering the dense block.
     center: Any | None = None
+    # L-BFGS iterations actually executed (None for the adam solver) — the
+    # convergence diagnostic MLlib exposes via its training summary.
+    n_iter_run: int | None = None
 
     def decision_function(self, fm: FeatureMatrix) -> np.ndarray:
         batch = feature_batch(fm)
@@ -127,8 +130,10 @@ class LogisticRegression:
             b, yy, ww = d
             return weighted_logloss(p, scales, b, yy, ww, reg, center=center)
 
+        n_iter_run = None
         if self.solver == "lbfgs":
-            params, loss = _run_lbfgs(loss_fn, params, data, self.max_iter, self.tol)
+            params, loss, n_done = _run_lbfgs(loss_fn, params, data, self.max_iter, self.tol)
+            n_iter_run = int(n_done)
         elif self.solver == "adam":
             params, loss = _run_adam(loss_fn, params, data, self.max_iter, self.learning_rate)
         else:
@@ -137,6 +142,7 @@ class LogisticRegression:
         return LogisticRegressionModel(
             params=params, scales=scales, train_loss=float(loss),
             center=None if center is None else np.asarray(center),
+            n_iter_run=n_iter_run,
         )
 
     def fit_many(
@@ -198,7 +204,7 @@ class LogisticRegression:
 
         # Grid axis vmapped; the shared featurized batch enters unbatched as an
         # argument (in_axes=None), not as a baked-in constant.
-        params, losses = jax.jit(jax.vmap(solve, in_axes=(0, None)))(ws_dev, (batch, y))
+        params, losses, n_dones = jax.jit(jax.vmap(solve, in_axes=(0, None)))(ws_dev, (batch, y))
         center_np = None if center is None else np.asarray(center)
         return [
             LogisticRegressionModel(
@@ -206,6 +212,7 @@ class LogisticRegression:
                 scales=scales,
                 train_loss=float(losses[g]),
                 center=center_np,
+                n_iter_run=int(n_dones[g]),
             )
             for g in range(n_grid)
         ]
@@ -275,10 +282,10 @@ def _lbfgs_loop(loss_fn, params: Params, max_iter: int, tol: float):
             return ~bad & (i < max_iter) & ((i < 2) | ((flat < 3) & (gnorm > tol)))
 
         init = (params, state, jnp.inf, 0, jnp.bool_(False), 0)
-        params, state, value, _, _, _ = jax.lax.while_loop(cont, step, init)
+        params, state, value, n_done, _, _ = jax.lax.while_loop(cont, step, init)
         # Report the loss at the returned (finite) point, not the last
         # line-search value.
-        return params, loss_fn(params)
+        return params, loss_fn(params), n_done
 
     return run(params)
 
@@ -293,7 +300,7 @@ def _run_lbfgs(loss_fn, params: Params, data, max_iter: int, tol: float):
     def run(params, data):
         return _lbfgs_loop(lambda p: loss_fn(p, data), params, max_iter, tol)
 
-    return run(params, data)
+    return run(params, data)  # (params, loss, n_iterations_run)
 
 
 def _run_adam(loss_fn, params: Params, data, max_iter: int, lr: float):

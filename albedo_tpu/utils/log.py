@@ -24,7 +24,8 @@ def configure_logging(app_level: str | None = None) -> logging.Logger:
     # Literal map, not logging.getLevelNamesMapping() (3.11+ only; pyproject
     # supports 3.10).
     levels = {
-        "CRITICAL": logging.CRITICAL, "ERROR": logging.ERROR,
+        "CRITICAL": logging.CRITICAL, "FATAL": logging.CRITICAL,
+        "ERROR": logging.ERROR,
         "WARNING": logging.WARNING, "WARN": logging.WARNING,
         "INFO": logging.INFO, "DEBUG": logging.DEBUG, "NOTSET": logging.NOTSET,
     }

@@ -525,6 +525,7 @@ def ranker_bench() -> dict:
         "baseline_s": BASELINE_RANKER_TRAIN_S,
         "rows": int(result.n_rows),
         "auc": round(float(result.auc), 5),
+        "lr_iterations": result.model.lr_model.n_iter_run,
         "ndcg30": None if result.ndcg is None else round(float(result.ndcg), 5),
         "prep_s": round(prep_s, 3),
         "prep_profiles_s": round(profiles_s, 3),
