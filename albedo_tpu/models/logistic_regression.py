@@ -81,7 +81,7 @@ class LogisticRegression:
     standardization: bool = True
     solver: str = "lbfgs"      # "lbfgs" (MLlib parity) or "adam"
     learning_rate: float = 0.05  # adam only
-    tol: float = 1e-7
+    tol: float = 1e-6          # MLlib LogisticRegression default tol
     # Optional jax.sharding.Mesh: lay the batch out row-sharded over the
     # mesh's "data" axis (albedo_tpu.parallel.lr) — XLA then inserts the ICI
     # psums that replace MLlib's gradient treeAggregate.

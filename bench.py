@@ -151,11 +151,23 @@ def probe_backend() -> dict:
     raise AssertionError("unreachable")
 
 
+# Set by main() once the flagship ALS record is computed: a later watchdog
+# abort (e.g. the ranker stage crawling on a throttled tunnel) must re-emit
+# the GOOD headline as the last line rather than clobber it with an error —
+# the driver parses the last line only.
+FLAGSHIP_RECORD: dict | None = None
+
+
 def start_watchdog() -> None:
     """Abort with a structured record if the run wedges after a good probe
     (e.g. the chip is grabbed between probe and first compile)."""
 
     def abort():
+        if FLAGSHIP_RECORD is not None:
+            record = dict(FLAGSHIP_RECORD)
+            record["ranker_error"] = f"watchdog: bench exceeded {RUN_TIMEOUT_S}s"
+            print(json.dumps(record), flush=True)
+            os._exit(0)  # headline survived; only the ranker stage was lost
         record = error_record(
             "watchdog",
             f"bench exceeded {RUN_TIMEOUT_S}s watchdog",
@@ -633,23 +645,30 @@ def main() -> None:
     # failure is recorded in the final record, not fatal.
     ranker_error = None
     if os.environ.get("ALBEDO_BENCH_RANKER", "1") != "0":
-        print(json.dumps(als_record(train_s, ndcg, info, flop, mfu, peak_source,
-                                    gemm_f32, gemm_bf16, hbm_gbps, dispatch_s,
-                                    phases, None, als.solver, als.cg_steps, als.rank, als.max_iter)),
-              flush=True)
+        global FLAGSHIP_RECORD
+        FLAGSHIP_RECORD = als_record(
+            train_s, ndcg, info, flop, mfu, peak_source,
+            gemm_f32, gemm_bf16, hbm_gbps, dispatch_s,
+            phases, None, als.solver, als.cg_steps, als.rank, als.max_iter,
+        )
+        print(json.dumps(FLAGSHIP_RECORD), flush=True)
         try:
             print(json.dumps(ranker_bench()), flush=True)
         except Exception as e:  # noqa: BLE001
             ranker_error = repr(e)[-500:]
 
-    print(
-        json.dumps(
-            als_record(train_s, ndcg, info, flop, mfu, peak_source,
-                       gemm_f32, gemm_bf16, hbm_gbps, dispatch_s, phases,
-                       ranker_error, als.solver, als.cg_steps, als.rank, als.max_iter)
-        ),
-        flush=True,
-    )
+    if FLAGSHIP_RECORD is not None:
+        final = dict(FLAGSHIP_RECORD)
+        final["ranker_error"] = ranker_error
+    else:
+        final = als_record(train_s, ndcg, info, flop, mfu, peak_source,
+                           gemm_f32, gemm_bf16, hbm_gbps, dispatch_s, phases,
+                           ranker_error, als.solver, als.cg_steps, als.rank,
+                           als.max_iter)
+    print(json.dumps(final), flush=True)
+    # The run is complete: a teardown hang must not let the watchdog re-print
+    # the headline with a spurious ranker_error as the new last line.
+    FLAGSHIP_RECORD = None
 
 
 def als_record(train_s, ndcg, info, flop, mfu, peak_source,

@@ -117,3 +117,17 @@ def test_recall_vs_iterations_curve(mid_matrix):
     # Pinned floor: planted rank-12 structure at this scale recovers well over
     # a third of held-out stars in the top-30 (observed ~baseline, see commit).
     assert curve[12] > 0.35, curve
+
+
+def test_cg_solver_holds_recall_floor(mid_matrix):
+    """The fast warm-started-CG path (the bench's solver) must match the exact
+    solver's held-out recall within noise at the same anchor scale — the drift
+    gate for CG-specific regressions (preconditioner, warm starts, step count)."""
+    train, test = random_split_by_user(mid_matrix, test_ratio=0.2, seed=5)
+    kw = dict(rank=16, reg_param=0.1, alpha=40.0, max_iter=12, seed=0)
+    exact = ImplicitALS(**kw).fit(train)
+    fast = ImplicitALS(**kw, solver="cg").fit(train)
+    r_exact = recall_at_k(exact, train, test)
+    r_fast = recall_at_k(fast, train, test)
+    assert r_fast >= r_exact - 0.03, (r_fast, r_exact)
+    assert r_fast > 0.35, r_fast
