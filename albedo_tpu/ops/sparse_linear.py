@@ -32,13 +32,43 @@ Params = dict[str, Any]
 
 
 def feature_batch(fm: FeatureMatrix) -> dict[str, jnp.ndarray]:
-    """Upload a FeatureMatrix's arrays as a flat dict of device arrays."""
+    """Upload a FeatureMatrix's arrays as a flat dict of device arrays.
+
+    Bag fields are laid out as DUAL-SORTED flat arrays rather than the padded
+    ``(N, L)`` arrays the host keeps: the padded-gather formulation costs a
+    random-order 49M-element gather forward and a random scatter-add backward
+    on TPU — measured ~95% of the LR fit (1.62 s vs 0.20 s per value_and_grad
+    at bench scale). The flat layout carries a row-sorted copy (+ row indptr)
+    for the forward and a vocab-sorted copy (+ vocab indptr) for the weight
+    gradient, so BOTH directions reduce by the cumsum-difference trick over
+    only the real entries (``_bag_term``) — no scatter at all. The mesh path
+    (``parallel.lr.shard_feature_batch``) keeps the padded layout — a
+    row-shardable rectangle — and ``block_logits`` consumes either.
+    """
     batch: dict[str, jnp.ndarray] = {"dense": jnp.asarray(fm.dense)}
     for f, v in fm.cat.items():
         batch[f"cat:{f}"] = jnp.asarray(v)
     for f in fm.bag_idx:
-        batch[f"bag_idx:{f}"] = jnp.asarray(fm.bag_idx[f])
-        batch[f"bag_val:{f}"] = jnp.asarray(fm.bag_val[f])
+        idx, val = fm.bag_idx[f], fm.bag_val[f]
+        n = idx.shape[0]
+        ok = idx >= 0
+        rows = np.broadcast_to(np.arange(n, dtype=np.int64)[:, None], idx.shape)[ok]
+        vocab = idx[ok].astype(np.int32)
+        vals = val[ok].astype(np.float32)
+        order = np.argsort(vocab, kind="stable")
+        # Vocab indptr spans the FULL weight table, so the backward
+        # cumsum-difference yields a gradient shaped exactly like the table.
+        v_size = fm.bag_sizes[f]
+        r_indptr = np.zeros(n + 1, np.int32)
+        np.cumsum(np.bincount(rows, minlength=n), out=r_indptr[1:])
+        v_indptr = np.zeros(v_size + 1, np.int32)
+        np.cumsum(np.bincount(vocab, minlength=v_size), out=v_indptr[1:])
+        batch[f"bagflat:{f}:r_vocab"] = jnp.asarray(vocab)              # row-sorted
+        batch[f"bagflat:{f}:r_val"] = jnp.asarray(vals)
+        batch[f"bagflat:{f}:r_indptr"] = jnp.asarray(r_indptr)
+        batch[f"bagflat:{f}:v_rows"] = jnp.asarray(rows[order].astype(np.int32))
+        batch[f"bagflat:{f}:v_val"] = jnp.asarray(vals[order])          # vocab-sorted
+        batch[f"bagflat:{f}:v_indptr"] = jnp.asarray(v_indptr)
     return batch
 
 
@@ -113,6 +143,46 @@ def dense_center(fm: FeatureMatrix) -> np.ndarray:
     return fm.dense.astype(np.float64).mean(axis=0).astype(np.float32)
 
 
+def _segment_sums(data: jnp.ndarray, indptr: jnp.ndarray) -> jnp.ndarray:
+    """Sorted-segment sums via the cumsum-difference trick: an exclusive
+    cumsum gathered at segment boundaries. No scatter — TPU scatters and
+    large random gathers both measured ~100x slower than this streaming
+    formulation for the bag blocks. float32 cumsum over ~10^7 mixed-sign
+    entries costs ~eps * |running total| per segment (~1e-4 absolute on
+    bench-scale logits) — well inside LR tolerance; gradient parity vs the
+    padded path is test-pinned."""
+    c = jnp.concatenate([jnp.zeros(1, data.dtype), jnp.cumsum(data)])
+    return c[indptr[1:]] - c[indptr[:-1]]
+
+
+def _bag_term(
+    w: jnp.ndarray,           # (V,) effective bag weights (params * scales)
+    r_vocab: jnp.ndarray, r_val: jnp.ndarray, r_indptr: jnp.ndarray,
+    v_rows: jnp.ndarray, v_val: jnp.ndarray, v_indptr: jnp.ndarray,
+) -> jnp.ndarray:
+    """Per-row bag logit contribution with a cumsum-difference VJP.
+
+    Forward: per-row sums of ``w[r_vocab] * r_val`` over the row-sorted flat
+    entries. Backward wrt ``w``: the SAME reduction over the vocab-sorted
+    copy. Plain autodiff of the padded form emits a random scatter-add (and
+    its forward a 49M-element random gather) — measured 8x slower end-to-end
+    at bench scale on TPU."""
+
+    @jax.custom_vjp
+    def term(w):
+        return _segment_sums(w[r_vocab] * r_val, r_indptr)
+
+    def fwd(w):
+        return term(w), None
+
+    def bwd(_, g):
+        # v_indptr spans the full weight table, so this is (V,) exactly.
+        return (_segment_sums(g[v_rows] * v_val, v_indptr),)
+
+    term.defvjp(fwd, bwd)
+    return term(w)
+
+
 def block_logits(
     params: Params,
     scales: Params,
@@ -121,7 +191,10 @@ def block_logits(
 ) -> jnp.ndarray:
     """(N,) logits; ``params`` are standardized-space coefficients and
     ``scales`` the per-feature 1/std factors (use all-ones for raw space).
-    ``center`` (optional) is subtracted from the dense block before scaling."""
+    ``center`` (optional) is subtracted from the dense block before scaling.
+
+    Bag fields arrive either flat-dual-sorted (``feature_batch``; fast VJP)
+    or padded (``parallel.lr.shard_feature_batch``; row-shardable)."""
     dense = batch["dense"] if center is None else batch["dense"] - center
     logits = params["bias"] + (dense * scales["dense"]) @ params["dense"]
     for key, arr in batch.items():
@@ -129,6 +202,15 @@ def block_logits(
             f = key[len("cat:"):]
             w = params[f"cat:{f}"] * scales[f"cat:{f}"]
             logits = logits + w[arr]
+        elif key.startswith("bagflat:") and key.endswith(":r_vocab"):
+            f = key[len("bagflat:"):-len(":r_vocab")]
+            w = params[f"bag:{f}"] * scales[f"bag:{f}"]
+            p = f"bagflat:{f}:"
+            logits = logits + _bag_term(
+                w,
+                batch[p + "r_vocab"], batch[p + "r_val"], batch[p + "r_indptr"],
+                batch[p + "v_rows"], batch[p + "v_val"], batch[p + "v_indptr"],
+            )
         elif key.startswith("bag_idx:"):
             f = key[len("bag_idx:"):]
             w = params[f"bag:{f}"] * scales[f"bag:{f}"]

@@ -163,8 +163,9 @@ def start_watchdog() -> None:
     (e.g. the chip is grabbed between probe and first compile)."""
 
     def abort():
-        if FLAGSHIP_RECORD is not None:
-            record = dict(FLAGSHIP_RECORD)
+        flagship = FLAGSHIP_RECORD  # snapshot: main() may null it concurrently
+        if flagship is not None:
+            record = dict(flagship)
             record["ranker_error"] = f"watchdog: bench exceeded {RUN_TIMEOUT_S}s"
             print(json.dumps(record), flush=True)
             os._exit(0)  # headline survived; only the ranker stage was lost
