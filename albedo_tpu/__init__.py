@@ -11,8 +11,11 @@ Layer map (mirrors SURVEY.md section 1, re-architected TPU-first):
   reindexing, stratified splits, date-keyed artifact cache. Replaces the
   reference's JDBC + parquet layer (``utils/DatasetUtils.scala``).
 - ``albedo_tpu.ops``       -- device compute primitives: bucketed ragged
-  gathers, Gramian accumulation, batched Cholesky solves, blocked score GEMM +
-  top-k (XLA and Pallas paths). Replaces netlib BLAS hot loops.
+  gathers, Gramian accumulation, batched normal-equation solves (exact
+  Cholesky or matrix-free warm-started CG), blocked score GEMM + top-k,
+  scatter-free block-sparse linear ops. All fusion-friendly XLA HLO —
+  ``ops/als.py`` documents why a hand-written Pallas kernel would lose to
+  the compiler here. Replaces netlib BLAS hot loops.
 - ``albedo_tpu.models``    -- ImplicitALS, LogisticRegression, Word2Vec as
   JAX estimators. Replaces Spark MLlib ``ALS``/``LogisticRegression``/``Word2Vec``.
 - ``albedo_tpu.pipeline``  -- Estimator/Transformer/Pipeline protocol and the

@@ -15,11 +15,14 @@ indefinitely), the probe retries (ALBEDO_BENCH_PROBE_ATTEMPTS, default 3, with
 a backoff between attempts), a watchdog aborts a wedged run, and every failure
 path emits one structured JSON line and exits nonzero.
 
-Reports MFU from an analytic FLOP model of the sweep (per padded bucket:
-Gramian correction einsum 2BLk^2, batched Cholesky Bk^3/3, solves) against
-the chip's published bf16 peak (JAX's default f32 matmul precision on TPU
-uses bf16 MXU passes) plus a measured large-GEMM rate as the achievable
-roofline.
+Trains with the warm-started-CG solver by default (ALBEDO_BENCH_SOLVER=
+cholesky for the exact MLlib-parity solve; identical NDCG gate either way)
+and reports a solver-aware analytic FLOP model against the chip's published
+bf16 peak, a measured chained-GEMM rate, AND a measured HBM streaming rate
+with a bytes-per-iteration model — the sweep is bandwidth-bound, so
+vs_bandwidth_roofline is the honest utilization figure. A per-phase
+breakdown (gather / solve / scatter) and the measured per-dispatch latency
+round out the record.
 
 Output contract: the LAST line printed is the flagship JSON record
 {"metric": "als_train_wallclock_rank50_iter26", "value", "unit",
