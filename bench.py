@@ -275,7 +275,10 @@ def measured_gemm_flops_per_s(jnp, jax, dtype, n: int = GEMM_N, chain: int = GEM
     return 2.0 * n**3 * chain / best
 
 
-def measured_hbm_gbps(jnp, jax, n_floats: int = 1 << 28, chain: int = 16) -> float:
+HBM_FLOATS = int(os.environ.get("ALBEDO_BENCH_HBM_FLOATS", str(1 << 28)))
+
+
+def measured_hbm_gbps(jnp, jax, n_floats: int | None = None, chain: int = 16) -> float:
     """Achievable HBM streaming bandwidth: ``chain`` dependent elementwise
     passes over a 1 GiB array inside one jitted scan (each step reads + writes
     the full array; dispatch latency amortized as in the GEMM roofline).
@@ -283,6 +286,8 @@ def measured_hbm_gbps(jnp, jax, n_floats: int = 1 << 28, chain: int = 16) -> flo
     The ALS sweep is BANDWIDTH-bound, not FLOP-bound — each CG matvec streams
     the gathered (B, L, k) ratings blocks — so the honest roofline for it is
     bytes/s, not the MXU TF/s that a dense-GEMM workload would get."""
+    if n_floats is None:
+        n_floats = HBM_FLOATS  # env knob (tests shrink it)
     x = jnp.ones((n_floats,), jnp.float32)
 
     @jax.jit
@@ -478,6 +483,10 @@ def ranker_bench() -> dict:
     n_users = int(os.environ.get("ALBEDO_BENCH_RANKER_USERS", "8000"))
     n_items = int(os.environ.get("ALBEDO_BENCH_RANKER_ITEMS", "5000"))
     mean_stars = float(os.environ.get("ALBEDO_BENCH_RANKER_MEAN_STARS", "20"))
+
+    # Fault-injection hook (tests): stall the ranker stage so the watchdog's
+    # flagship-preserving abort path can be exercised deterministically.
+    time.sleep(float(os.environ.get("ALBEDO_BENCH_FAULT_SLEEP", "0")))
 
     tag = md5(f"bench-ranker-{n_users}-{n_items}-{mean_stars}")[:10]
     # Cold prerequisites by default: drop this bench's cached artifacts so

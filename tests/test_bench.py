@@ -61,3 +61,34 @@ def test_bench_error_record_is_json(tmp_path):
     record = json.loads(line)
     assert record["stage"] == "backend_probe"
     assert record["value"] is None and record["error"]
+
+
+def test_watchdog_preserves_flagship_record():
+    """If the watchdog fires AFTER the ALS headline is computed (a wedged or
+    crawling ranker stage), the bench must exit 0 with the GOOD flagship
+    record as its last line — the driver parses the last line only."""
+    import os
+
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "ALBEDO_BENCH_PLATFORM": "cpu",
+        "ALBEDO_BENCH_USERS": "300", "ALBEDO_BENCH_ITEMS": "200",
+        "ALBEDO_BENCH_ITERS": "1", "ALBEDO_BENCH_MEAN_STARS": "6",
+        "ALBEDO_BENCH_GEMM_N": "256", "ALBEDO_BENCH_GEMM_CHAIN": "2",
+        "ALBEDO_BENCH_HBM_FLOATS": str(1 << 20),
+        "ALBEDO_BENCH_BREAKDOWN": "0",
+        "ALBEDO_BENCH_RANKER": "1",
+        # Deterministic fault injection: stall the ranker past the watchdog.
+        "ALBEDO_BENCH_FAULT_SLEEP": "3600",
+        "ALBEDO_BENCH_TIMEOUT": "90",
+    })
+    proc = subprocess.run(
+        [sys.executable, str(bench.__file__)],
+        capture_output=True, text=True, timeout=300, env=env,
+    )
+    assert proc.returncode == 0, proc.stdout[-500:] + proc.stderr[-500:]
+    record = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert record["metric"] == "als_train_wallclock_rank50_iter26"
+    assert record["value"] is not None and record["value"] > 0
+    assert "watchdog" in (record["ranker_error"] or "")
