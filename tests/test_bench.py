@@ -81,7 +81,7 @@ def test_watchdog_preserves_flagship_record():
         "ALBEDO_BENCH_RANKER": "1",
         # Deterministic fault injection: stall the ranker past the watchdog.
         "ALBEDO_BENCH_FAULT_SLEEP": "3600",
-        "ALBEDO_BENCH_TIMEOUT": "90",
+        "ALBEDO_BENCH_TIMEOUT": "35",
     })
     proc = subprocess.run(
         [sys.executable, str(bench.__file__)],

@@ -61,8 +61,8 @@ def test_bucket_rows_covers_all_nonzeros():
     # Padded values are zero so confidence weights vanish on pads.
     for b in buckets:
         assert (b.val[~b.mask] == 0).all()
-    # Bounded shape count: ~1.25x geometric length tiers x pow-2 slot counts
-    # trade a few more shapes for <=~25% per-row padding (vs 2x at pow-2 tiers).
+    # Bounded shape count: ~1.15x geometric length tiers x pow-2 slot counts
+    # trade a few more shapes for <=~15% per-row padding (vs 2x at pow-2 tiers).
     assert len(bucket_shapes(buckets)) <= 20
 
 
