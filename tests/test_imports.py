@@ -1,0 +1,18 @@
+"""Every module in the package must import cleanly — catches import-time
+breakage in modules no other test happens to touch (the reference has no
+equivalent; its JVM build at least enforced compilation)."""
+
+import importlib
+import pkgutil
+
+import albedo_tpu
+
+
+def test_all_modules_import():
+    failures = []
+    for mod in pkgutil.walk_packages(albedo_tpu.__path__, prefix="albedo_tpu."):
+        try:
+            importlib.import_module(mod.name)
+        except Exception as e:  # noqa: BLE001
+            failures.append((mod.name, repr(e)))
+    assert not failures, failures
