@@ -295,11 +295,23 @@ def train_ranker(
                 fm_train, labels,
                 sample_weight=train_w[config.weight_col].to_numpy(np.float32),
             )
+            first_model = lr_model
         else:
             ws = np.stack(
                 [train_w[c].to_numpy(np.float32) for c in weight_cols]
             )
             grid_models = lr.fit_many(fm_train, labels, ws, grid_mesh=grid_mesh)
+            first_model = grid_models[0]
+    # Re-attribute XLA compile out of the lr_fit stage: compile is a one-time
+    # per-shape cost (0 on a warm executable cache), not LR training — the r4
+    # bench's lr_fit conflated the two and read as 63% of the ranker
+    # wall-clock (VERDICT r4 #1).
+    if first_model.compile_s is not None:
+        timer.totals["lr_fit"] -= first_model.compile_s
+        timer.totals["lr_compile"] = (
+            timer.totals.get("lr_compile", 0.0) + first_model.compile_s
+        )
+        timer.counts["lr_compile"] = timer.counts.get("lr_compile", 0) + 1
 
     # 6a. AUC on the held-out split (:354-364).
     with timer.section("auc_eval"):

@@ -12,6 +12,7 @@ is the ICI analogue of Spark's treeAggregate.
 from __future__ import annotations
 
 import dataclasses
+import time
 from typing import Any
 
 import jax
@@ -50,6 +51,11 @@ class LogisticRegressionModel:
     # L-BFGS iterations actually executed (None for the adam solver) — the
     # convergence diagnostic MLlib exposes via its training summary.
     n_iter_run: int | None = None
+    # Wall-clock split of the fit: XLA compile (0 when the in-process
+    # executable cache was warm — see _aot_call) vs the actual solve. The r4
+    # ranker bench conflated the two inside its lr_fit stage (VERDICT r4 #1).
+    compile_s: float | None = None
+    run_s: float | None = None
 
     def decision_function(self, fm: FeatureMatrix) -> np.ndarray:
         batch = feature_batch(fm)
@@ -119,22 +125,32 @@ class LogisticRegression:
 
         scales, center = self._prepare_scales(fm)
         params = init_params(fm)
-        reg = float(self.reg_param)
-
-        # The batch rides as a jit ARGUMENT (see _run_lbfgs): a closure would
-        # embed it as an HLO constant, which at real scale exceeds the remote
-        # compile service's request limit (HTTP 413 on the tunneled backend).
-        data = (batch, y, w)
-
-        def loss_fn(p, d):
-            b, yy, ww = d
-            return weighted_logloss(p, scales, b, yy, ww, reg, center=center)
 
         n_iter_run = None
+        compile_s = run_s = None
         if self.solver == "lbfgs":
-            params, loss, n_done = _run_lbfgs(loss_fn, params, data, self.max_iter, self.tol)
+            # The batch rides as an ARGUMENT of a module-level jit (a closure
+            # would embed it as an HLO constant — HTTP 413 on the tunneled
+            # backend at real scale) and max_iter/tol are traced scalars, so
+            # the executable is cached across fits of same-shaped data
+            # in-process; _aot_call separates compile from run wall-clock.
+            args = (
+                params, scales, center, jnp.float32(self.reg_param),
+                batch, y, w, jnp.int32(self.max_iter), jnp.float32(self.tol),
+            )
+            t0 = time.perf_counter()
+            (params, loss, n_done), compile_s = _aot_call(_lbfgs_fit_jit, args)
+            loss = float(loss)  # d2h read: reliable completion barrier
+            run_s = time.perf_counter() - t0 - compile_s
             n_iter_run = int(n_done)
         elif self.solver == "adam":
+            reg = float(self.reg_param)
+            data = (batch, y, w)
+
+            def loss_fn(p, d):
+                b, yy, ww = d
+                return weighted_logloss(p, scales, b, yy, ww, reg, center=center)
+
             params, loss = _run_adam(loss_fn, params, data, self.max_iter, self.learning_rate)
         else:
             raise ValueError(f"unknown solver {self.solver!r}")
@@ -142,7 +158,7 @@ class LogisticRegression:
         return LogisticRegressionModel(
             params=params, scales=scales, train_loss=float(loss),
             center=None if center is None else np.asarray(center),
-            n_iter_run=n_iter_run,
+            n_iter_run=n_iter_run, compile_s=compile_s, run_s=run_s,
         )
 
     def fit_many(
@@ -178,15 +194,6 @@ class LogisticRegression:
         y = jnp.asarray(labels, dtype=jnp.float32)
         scales, center = self._prepare_scales(fm)
         params0 = init_params(fm)
-        reg = float(self.reg_param)
-
-        def solve(w, data):
-            b, yy = data
-
-            def loss_fn(p):
-                return weighted_logloss(p, scales, b, yy, w, reg, center=center)
-
-            return _lbfgs_loop(loss_fn, params0, self.max_iter, self.tol)
 
         if grid_mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
@@ -202,9 +209,17 @@ class LogisticRegression:
         else:
             ws_dev = jnp.asarray(ws)
 
-        # Grid axis vmapped; the shared featurized batch enters unbatched as an
-        # argument (in_axes=None), not as a baked-in constant.
-        params, losses, n_dones = jax.jit(jax.vmap(solve, in_axes=(0, None)))(ws_dev, (batch, y))
+        # Grid axis vmapped; the shared featurized batch enters unbatched as
+        # an argument, not as a baked-in constant. Same AOT executable cache
+        # and compile/run split as single fits.
+        args = (
+            params0, scales, center, jnp.float32(self.reg_param),
+            batch, y, ws_dev, jnp.int32(self.max_iter), jnp.float32(self.tol),
+        )
+        t0 = time.perf_counter()
+        (params, losses, n_dones), compile_s = _aot_call(_lbfgs_fit_many_jit, args)
+        losses = np.asarray(losses)  # d2h read: reliable completion barrier
+        run_s = time.perf_counter() - t0 - compile_s
         center_np = None if center is None else np.asarray(center)
         return [
             LogisticRegressionModel(
@@ -213,6 +228,8 @@ class LogisticRegression:
                 train_loss=float(losses[g]),
                 center=center_np,
                 n_iter_run=int(n_dones[g]),
+                compile_s=compile_s,
+                run_s=run_s,
             )
             for g in range(n_grid)
         ]
@@ -290,17 +307,76 @@ def _lbfgs_loop(loss_fn, params: Params, max_iter: int, tol: float):
     return run(params)
 
 
-def _run_lbfgs(loss_fn, params: Params, data, max_iter: int, tol: float):
-    """jit wrapper around ``_lbfgs_loop``: ``data`` (the feature batch pytree)
-    enters as an argument, so the HLO stays small — a closure would serialize
-    the whole batch as a constant into the compile request (HTTP 413 on the
-    tunneled TPU backend at real scale). ``loss_fn(params, data)``."""
+def _lbfgs_fit_impl(params, scales, center, reg, batch, y, w, max_iter, tol):
+    """The full-batch weighted-LR L-BFGS solve as a pure function of arrays.
 
-    @jax.jit
-    def run(params, data):
-        return _lbfgs_loop(lambda p: loss_fn(p, data), params, max_iter, tol)
+    Everything data-like (batch pytree, labels, weights, reg, max_iter, tol)
+    is a traced argument: the HLO stays small (a closed-over batch would
+    serialize into the compile request — HTTP 413 on the tunneled backend)
+    and ONE executable serves every fit with same-shaped data, any
+    max_iter/tol/reg value."""
 
-    return run(params, data)  # (params, loss, n_iterations_run)
+    def loss_fn(p):
+        return weighted_logloss(p, scales, batch, y, w, reg, center=center)
+
+    return _lbfgs_loop(loss_fn, params, max_iter, tol)
+
+
+_lbfgs_fit_jit = jax.jit(_lbfgs_fit_impl)
+
+
+def _lbfgs_fit_many_impl(params0, scales, center, reg, batch, y, ws, max_iter, tol):
+    """Vmapped grid of L-BFGS solves over weight rows (shared featurized
+    batch enters unbatched; only ``ws`` carries the grid axis)."""
+
+    def solve(w):
+        def loss_fn(p):
+            return weighted_logloss(p, scales, batch, y, w, reg, center=center)
+
+        return _lbfgs_loop(loss_fn, params0, max_iter, tol)
+
+    return jax.vmap(solve)(ws)
+
+
+_lbfgs_fit_many_jit = jax.jit(_lbfgs_fit_many_impl)
+
+
+# Compiled-executable cache for the module-level jits above, keyed on the
+# argument signature (treedef + shapes/dtypes). jax.jit would reuse its own
+# cache too, but going through .lower()/.compile() explicitly lets callers
+# time XLA compilation separately from the solve — the split the ranker bench
+# publishes (VERDICT r4 #1: 63% of the r4 ranker wall-clock was LR compile
+# hidden inside the lr_fit stage).
+_AOT_CACHE: dict = {}
+
+
+def _aot_call(jitted, args):
+    """Call ``jitted(*args)`` through an explicit lower/compile step.
+
+    Returns ``(outputs, compile_s)`` — ``compile_s`` is 0.0 on a warm cache.
+    """
+    leaves, treedef = jax.tree.flatten(args)
+    key = (
+        id(jitted), treedef,
+        tuple(
+            (
+                tuple(getattr(x, "shape", ())),
+                str(getattr(x, "dtype", type(x))),
+                # Shardings are part of the compiled signature: an executable
+                # built for replicated args must not serve mesh-sharded ones.
+                str(getattr(x, "sharding", None)),
+            )
+            for x in leaves
+        ),
+    )
+    compiled = _AOT_CACHE.get(key)
+    compile_s = 0.0
+    if compiled is None:
+        t0 = time.perf_counter()
+        compiled = jitted.lower(*args).compile()
+        compile_s = time.perf_counter() - t0
+        _AOT_CACHE[key] = compiled
+    return compiled(*args), compile_s
 
 
 def _run_adam(loss_fn, params: Params, data, max_iter: int, lr: float):

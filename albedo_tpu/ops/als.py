@@ -46,6 +46,30 @@ def gramian(factors: jax.Array) -> jax.Array:
     return factors.T @ factors
 
 
+def _gather(source: jax.Array, idx: jax.Array, gather_dtype) -> jax.Array:
+    """Row-gather the fixed side's factors, optionally through a reduced-
+    precision copy of the table.
+
+    With ``gather_dtype="bfloat16"`` the (tiny) factor table is cast once and
+    the (huge) gathered ``(B, L, k)`` blocks live in bf16 in HBM — halving the
+    streamed bytes of the bandwidth-bound sweep. All contractions over the
+    gathered blocks accumulate in float32 (``preferred_element_type``), the
+    MXU's native bf16-in/f32-out mode."""
+    if gather_dtype is None:
+        return source[idx]
+    return source.astype(jnp.dtype(gather_dtype))[idx]
+
+
+def _gdot(spec: str, gathered: jax.Array, other: jax.Array) -> jax.Array:
+    """Einsum against the gathered block with f32 accumulation; the non-block
+    operand is cast to the block's (possibly bf16) dtype so the MXU consumes
+    both natively instead of upcasting the big block to f32 in HBM."""
+    return jnp.einsum(
+        spec, gathered, other.astype(gathered.dtype),
+        preferred_element_type=jnp.float32,
+    )
+
+
 def bucket_solve_body(
     source: jax.Array,   # (n_source, k) fixed side's factors
     yty: jax.Array,      # (k, k) gramian of `source`
@@ -54,21 +78,25 @@ def bucket_solve_body(
     mask: jax.Array,     # (B, L) bool
     reg: jax.Array,      # () float32 regParam
     alpha: jax.Array,    # () float32 confidence scale
+    gather_dtype=None,   # None = f32 gathers; "bfloat16" halves streamed bytes
 ) -> jax.Array:
     """The normal-equation solve for a padded bucket: gather → fused Gramian
     correction → batched Cholesky. Shared by the single-device and shard_map'd
     paths (``parallel.als``), so a parity fix lands in both."""
     k = source.shape[1]
-    gathered = source[idx]                      # (B, L, k)
+    gathered = _gather(source, idx, gather_dtype)  # (B, L, k)
     c1 = alpha * val                            # (B, L); 0 on padding
     w = jnp.where(mask, 1.0 + c1, 0.0)          # b-vector weights
 
     # A_b = YtY + sum_l c1 * y y^T + reg * n_b * I
-    corr = jnp.einsum("blk,bl,blm->bkm", gathered, c1, gathered)
+    corr = jnp.einsum(
+        "blk,bl,blm->bkm", gathered, c1.astype(gathered.dtype), gathered,
+        preferred_element_type=jnp.float32,
+    )
     n_b = mask.sum(axis=1).astype(jnp.float32)
-    eye = jnp.eye(k, dtype=source.dtype)
+    eye = jnp.eye(k, dtype=jnp.float32)
     a_mat = yty[None] + corr + (reg * n_b)[:, None, None] * eye
-    b_vec = jnp.einsum("blk,bl->bk", gathered, w)
+    b_vec = _gdot("blk,bl->bk", gathered, w)
 
     chol = jnp.linalg.cholesky(a_mat)
     return jax.scipy.linalg.cho_solve((chol, True), b_vec[..., None])[..., 0]
@@ -84,6 +112,7 @@ def bucket_cg_body(
     reg: jax.Array,      # () float32 regParam
     alpha: jax.Array,    # () float32 confidence scale
     cg_steps: int,
+    gather_dtype=None,   # None = f32 gathers; "bfloat16" halves streamed bytes
 ) -> jax.Array:
     """Matrix-free Jacobi-preconditioned conjugate gradient on the implicit
     normal equations — never materializes the (B, k, k) systems.
@@ -99,25 +128,25 @@ def bucket_cg_body(
     MLlib's exact per-block Cholesky (what ``bucket_solve_body`` mirrors)
     remains the parity reference.
     """
-    gathered = source[idx]                      # (B, L, k)
+    gathered = _gather(source, idx, gather_dtype)  # (B, L, k)
     c1 = alpha * val                            # (B, L); 0 on padding
     w = jnp.where(mask, 1.0 + c1, 0.0)
     n_b = mask.sum(axis=1).astype(jnp.float32)
-    b_vec = jnp.einsum("blk,bl->bk", gathered, w)
+    b_vec = _gdot("blk,bl->bk", gathered, w)
 
     # Jacobi preconditioner: diag(A) = diag(YtY) + sum_l c1 y_l^2 + reg n.
     diag = (
         jnp.diagonal(yty)[None]
-        + jnp.einsum("blk,bl->bk", gathered * gathered, c1)
+        + _gdot("blk,bl->bk", gathered * gathered, c1)
         + (reg * n_b)[:, None]
     )
     diag = jnp.maximum(diag, 1e-12)
 
     def matvec(p):
-        t = c1 * jnp.einsum("blk,bk->bl", gathered, p)
+        t = c1 * _gdot("blk,bk->bl", gathered, p)
         return (
             p @ yty
-            + jnp.einsum("blk,bl->bk", gathered, t)
+            + _gdot("blk,bl->bk", gathered, t)
             + (reg * n_b)[:, None] * p
         )
 
@@ -192,6 +221,8 @@ def scan_half_sweep(
     alpha: jax.Array,
     solver: str = "cholesky",
     cg_steps: int = 3,
+    landing: jax.Array | None = None,
+    gather_dtype=None,
 ) -> jax.Array:
     """Traceable half-sweep over stacked same-shape bucket groups
     (``ragged.group_buckets``): one ``lax.scan`` per distinct shape, so the
@@ -201,6 +232,14 @@ def scan_half_sweep(
     is irrelevant. ``solver="cholesky"`` is the exact MLlib-parity solve
     (``bucket_solve_body``, shared with the per-bucket and shard_map paths);
     ``solver="cg"`` is the matrix-free warm-started CG (``bucket_cg_body``).
+
+    ``landing`` (``models.als`` precomputes it on host) is the inverse
+    permutation that lands solved rows by a GATHER from
+    ``concat(solved_blocks..., target)`` instead of a scatter into ``target``
+    — TPU scatters serialize (measured ~0.03 s/iter, the largest single
+    phase of the r4 CG iteration) while the equivalent gather streams.
+    ``landing[r] = flat slot position of row r``, or ``n_slots + r`` to keep
+    the old factor for rows in no bucket.
     """
     if solver not in ("cholesky", "cg"):
         raise ValueError(f"unknown solver {solver!r} (expected 'cholesky' or 'cg')")
@@ -209,18 +248,21 @@ def scan_half_sweep(
     # Every target row appears in exactly one bucket, so the solves never
     # read rows written this half-sweep: solve all groups against the
     # PRE-SWEEP target (CG warm starts read it), collect the solved blocks,
-    # and land them with ONE scatter — keeping the (n_target, k) table out
-    # of the scan carry (measured r4: the per-step carried scatter was the
-    # largest phase, 0.09 s of a 0.15 s CG iteration).
+    # and land them in ONE gather (or scatter, without `landing`) — keeping
+    # the (n_target, k) table out of the scan carry.
     def body(_, g):
         row_ids, idx, val, mask = g
         if solver == "cg":
             x0 = target[jnp.where(row_ids < 0, 0, row_ids)]
             solved = bucket_cg_body(
-                source, yty, idx, val, mask, x0, reg, alpha, cg_steps
+                source, yty, idx, val, mask, x0, reg, alpha, cg_steps,
+                gather_dtype=gather_dtype,
             )
         else:
-            solved = bucket_solve_body(source, yty, idx, val, mask, reg, alpha)
+            solved = bucket_solve_body(
+                source, yty, idx, val, mask, reg, alpha,
+                gather_dtype=gather_dtype,
+            )
         return None, solved
 
     k = target.shape[1]
@@ -229,16 +271,40 @@ def scan_half_sweep(
         _, solved = jax.lax.scan(body, None, (g.row_ids, g.idx, g.val, g.mask))
         all_rows.append(g.row_ids.reshape(-1))
         all_solved.append(solved.reshape(-1, k))
+    if landing is not None:
+        pool = jnp.concatenate(all_solved + [target])
+        return pool[landing]
     rows = jnp.concatenate(all_rows)
     solved = jnp.concatenate(all_solved)
     safe_rows = jnp.where(rows < 0, target.shape[0], rows)
     return target.at[safe_rows].set(solved, mode="drop")
 
 
+def _fit_loop(
+    user_f, item_f, user_groups, item_groups, reg, alpha, n_iter,
+    solver, cg_steps, user_landing=None, item_landing=None, gather_dtype=None,
+):
+    ug = [Bucket(*g) for g in user_groups]
+    ig = [Bucket(*g) for g in item_groups]
+
+    def iteration(_, carry):
+        uf, vf = carry
+        # MLlib order: item factors first (from user factors), then users.
+        vf = scan_half_sweep(
+            uf, vf, ig, reg, alpha, solver, cg_steps, item_landing, gather_dtype
+        )
+        uf = scan_half_sweep(
+            vf, uf, ug, reg, alpha, solver, cg_steps, user_landing, gather_dtype
+        )
+        return uf, vf
+
+    return jax.lax.fori_loop(0, n_iter, iteration, (user_f, item_f))
+
+
 @functools.partial(
     jax.jit,
     donate_argnames=("user_f", "item_f"),
-    static_argnames=("solver", "cg_steps"),
+    static_argnames=("solver", "cg_steps", "gather_dtype"),
 )
 def als_fit_fused(
     user_f: jax.Array,
@@ -250,6 +316,9 @@ def als_fit_fused(
     n_iter: jax.Array,         # traced scalar: one executable for any iter count
     solver: str = "cholesky",
     cg_steps: int = 3,
+    user_landing: jax.Array | None = None,
+    item_landing: jax.Array | None = None,
+    gather_dtype: str | None = None,
 ) -> tuple[jax.Array, jax.Array]:
     """The entire ALS fit as ONE device dispatch.
 
@@ -262,17 +331,48 @@ def als_fit_fused(
     traced scalar: warmup with ``n_iter=1`` reuses the same executable as the
     real run.
     """
-    ug = [Bucket(*g) for g in user_groups]
-    ig = [Bucket(*g) for g in item_groups]
+    return _fit_loop(
+        user_f, item_f, user_groups, item_groups, reg, alpha, n_iter,
+        solver, cg_steps, user_landing, item_landing, gather_dtype,
+    )
 
-    def iteration(_, carry):
-        uf, vf = carry
-        # MLlib order: item factors first (from user factors), then users.
-        vf = scan_half_sweep(uf, vf, ig, reg, alpha, solver, cg_steps)
-        uf = scan_half_sweep(vf, uf, ug, reg, alpha, solver, cg_steps)
-        return uf, vf
 
-    return jax.lax.fori_loop(0, n_iter, iteration, (user_f, item_f))
+@functools.partial(
+    jax.jit,
+    static_argnames=("n_users", "n_items", "rank", "solver", "cg_steps", "gather_dtype"),
+)
+def als_init_fit_fused(
+    key: jax.Array,            # PRNG key for the seeded factor init
+    user_groups: list[tuple],
+    item_groups: list[tuple],
+    reg: jax.Array,
+    alpha: jax.Array,
+    n_iter: jax.Array,
+    n_users: int,
+    n_items: int,
+    rank: int,
+    solver: str = "cholesky",
+    cg_steps: int = 3,
+    user_landing: jax.Array | None = None,
+    item_landing: jax.Array | None = None,
+    gather_dtype: str | None = None,
+) -> tuple[jax.Array, jax.Array]:
+    """``als_fit_fused`` with the seeded factor init INSIDE the program.
+
+    Creating the init factors eagerly costs ~6 separate device dispatches
+    (PRNGKey, split, 2x normal, 2x scale) — measured ~1.0 s of the 3.8 s r4
+    fit on the tunneled backend at ~70 ms/dispatch. Fusing the init into the
+    fit program makes the whole train ONE dispatch and the values identical
+    (same traced PRNG ops, same key).
+    """
+    ukey, ikey = jax.random.split(key)
+    scale = 1.0 / jnp.sqrt(jnp.float32(rank))
+    user_f = jax.random.normal(ukey, (n_users, rank), jnp.float32) * scale
+    item_f = jax.random.normal(ikey, (n_items, rank), jnp.float32) * scale
+    return _fit_loop(
+        user_f, item_f, user_groups, item_groups, reg, alpha, n_iter,
+        solver, cg_steps, user_landing, item_landing, gather_dtype,
+    )
 
 
 def implicit_loss(

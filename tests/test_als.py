@@ -218,3 +218,85 @@ def test_empty_user_keeps_init_factor():
     init = np.asarray(jax.random.normal(ukey, (3, 4), jnp.float32)) / np.sqrt(4)
     np.testing.assert_allclose(model.user_factors[0], init[0], rtol=1e-6)
     assert not np.allclose(model.user_factors[1], init[1])
+
+
+def test_bf16_gather_fit_quality(small_matrix):
+    """bf16 gathered factors (f32 tables/accumulation) must preserve ranking
+    quality: predictions track the f32 fit to high correlation and the
+    objective stays within a percent."""
+    m = small_matrix
+    kw = dict(rank=8, reg_param=0.5, alpha=10.0, max_iter=10, seed=1, solver="cg")
+    f32 = ImplicitALS(**kw).fit(m)
+    bf16 = ImplicitALS(**kw, gather_dtype="bfloat16").fit(m)
+
+    def loss(model):
+        return float(
+            implicit_loss(
+                jnp.asarray(model.user_factors), jnp.asarray(model.item_factors),
+                jnp.asarray(m.rows), jnp.asarray(m.cols), jnp.asarray(m.vals),
+                reg=0.5, alpha=10.0,
+            )
+        )
+
+    assert loss(bf16) <= loss(f32) * 1.01, (loss(bf16), loss(f32))
+    corr = float(np.corrcoef(f32.predict(m.rows, m.cols), bf16.predict(m.rows, m.cols))[0, 1])
+    assert corr > 0.995, corr
+
+
+def test_landing_perm_matches_scatter(small_matrix):
+    """The gather-based landing (inverse permutation) must produce exactly the
+    scatter path's result — same solved values, different write mechanism."""
+    from albedo_tpu.datasets.ragged import device_bucket, group_buckets
+    from albedo_tpu.models.als import _landing_perm
+    from albedo_tpu.ops.als import scan_half_sweep
+
+    m = small_matrix
+    rng = np.random.default_rng(5)
+    rank = 8
+    user_f = jnp.asarray(rng.normal(0, 0.1, (m.n_users, rank)).astype(np.float32))
+    item_f = jnp.asarray(rng.normal(0, 0.1, (m.n_items, rank)).astype(np.float32))
+    host_groups = group_buckets(bucket_rows(*m.csr(), batch_size=32))
+    groups = [device_bucket(g) for g in host_groups]
+    landing = jnp.asarray(_landing_perm(host_groups, m.n_users))
+    reg_a, alpha_a = jnp.float32(0.3), jnp.float32(10.0)
+    via_scatter = np.asarray(
+        scan_half_sweep(item_f, user_f, groups, reg_a, alpha_a, "cholesky")
+    )
+    via_landing = np.asarray(
+        scan_half_sweep(
+            item_f, user_f, groups, reg_a, alpha_a, "cholesky", landing=landing
+        )
+    )
+    np.testing.assert_array_equal(via_landing, via_scatter)
+
+
+def test_fused_init_matches_eager_init(small_matrix):
+    """The in-program seeded init (als_init_fit_fused) must produce the same
+    factors as an explicit warm start from the eagerly computed seeded init —
+    identical traced PRNG ops, identical key."""
+    m = small_matrix
+    kw = dict(rank=6, reg_param=0.5, alpha=10.0, max_iter=3, seed=7)
+    fused = ImplicitALS(**kw).fit(m)
+
+    key = jax.random.PRNGKey(7)
+    ukey, ikey = jax.random.split(key)
+    scale = 1.0 / np.sqrt(6)
+    uf0 = np.asarray(jax.random.normal(ukey, (m.n_users, 6), jnp.float32) * scale)
+    vf0 = np.asarray(jax.random.normal(ikey, (m.n_items, 6), jnp.float32) * scale)
+    warm = ImplicitALS(**kw, init_factors=(uf0, vf0)).fit(m)
+    np.testing.assert_allclose(
+        fused.user_factors, warm.user_factors, rtol=1e-5, atol=1e-6
+    )
+
+
+def test_fit_layout_cache_and_report(small_matrix):
+    """A second fit on the same matrix reuses the bucket layout + device
+    upload (prep_cached) and reports the wall-clock split."""
+    m = synthetic_stars(n_users=60, n_items=40, mean_stars=6, seed=23)
+    als = ImplicitALS(rank=4, max_iter=2, seed=0)
+    als.fit(m)
+    assert als.last_fit_report["prep_cached"] is False
+    als2 = ImplicitALS(rank=4, max_iter=2, seed=0)
+    als2.fit(m)
+    assert als2.last_fit_report["prep_cached"] is True
+    assert set(als2.last_fit_report) >= {"prep_s", "device_s", "prep_cached"}
