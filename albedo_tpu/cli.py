@@ -63,6 +63,11 @@ def main(argv: list[str] | None = None) -> int:
         import jax
 
         jax.config.update("jax_platforms", args.platform)
+    # After parsing (so --help / usage errors never pay the jax import):
+    # persistent executable cache — repeat job submissions skip XLA compile.
+    from albedo_tpu.utils.compilation_cache import enable_persistent_compilation_cache
+
+    enable_persistent_compilation_cache()
     args._rest = _rest  # job-specific flags (e.g. collect_data --db/--token)
     if args.job not in _JOBS:
         print(f"no such job: {args.job}", file=sys.stderr)

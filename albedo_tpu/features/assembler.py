@@ -63,27 +63,59 @@ def set_vocab_size(df: pd.DataFrame, col: str, size: int) -> None:
 
 @dataclasses.dataclass
 class FeatureMatrix:
-    """Assembled features for N rows, in blocks (see module docstring)."""
+    """Assembled features for N rows, in blocks (see module docstring).
 
-    dense: np.ndarray                    # (N, D) float32
-    dense_names: list[str]
+    The logical dense block is ``[scalar columns | vector columns]``;
+    vector columns (fixed-dim embeddings, e.g. word2vec documents) are
+    stored FACTORED as ``vec[f]`` (U_f, D_f) distinct vectors plus
+    ``vec_rep[f]`` (N,) representative indices: each user/repo document
+    repeats across ~100s of (user, repo) rows, so the expanded copy is
+    ~30-50x larger than the distinct set (657 MB vs ~20 MB at r5 ranker
+    bench scale — dominating the host->device upload). Device code gathers
+    ``vec[rep]`` instead; ``expanded_dense()`` materializes the flat layout
+    for compatibility paths."""
+
+    dense: np.ndarray                    # (N, D_scalar) float32
+    dense_names: list[str]               # scalar names then vec[f][i] names
     cat: dict[str, np.ndarray]           # field -> (N,) int32
     cat_sizes: dict[str, int]
-    bag_idx: dict[str, np.ndarray]       # field -> (N, L) int32, -1 on padding
-    bag_val: dict[str, np.ndarray]       # field -> (N, L) float32, 0 on padding
+    bag_idx: dict[str, np.ndarray]       # field -> (U_f|N, L) int32, -1 on padding
+    bag_val: dict[str, np.ndarray]       # field -> (U_f|N, L) float32, 0 on padding
     bag_sizes: dict[str, int]
+    vec: dict[str, np.ndarray] = dataclasses.field(default_factory=dict)
+    vec_rep: dict[str, np.ndarray] = dataclasses.field(default_factory=dict)
+    # Optional per-field (N,) rep indices into FACTORED bag rows: bag columns
+    # are per-user/per-repo documents repeated across ~50-80 (user, repo)
+    # rows, so the distinct-document representation shrinks the flat entry
+    # streams (and their per-linesearch-eval TPU gathers) by that factor.
+    # A field absent here keeps per-row (N, L) semantics.
+    bag_rep: dict[str, np.ndarray] = dataclasses.field(default_factory=dict)
 
     @property
     def n_rows(self) -> int:
         return int(self.dense.shape[0])
 
     @property
+    def dense_width(self) -> int:
+        """Width of the LOGICAL dense block: scalars + factored vec columns."""
+        return int(self.dense.shape[1]) + sum(int(v.shape[1]) for v in self.vec.values())
+
+    @property
     def num_features(self) -> int:
         """Width of the equivalent flat one-hot feature vector."""
         return (
-            self.dense.shape[1]
+            self.dense_width
             + sum(self.cat_sizes.values())
             + sum(self.bag_sizes.values())
+        )
+
+    def expanded_dense(self) -> np.ndarray:
+        """The (N, dense_width) dense block with vec fields expanded — the
+        pre-r5 layout, used by the row-sharded mesh path and to_dense."""
+        if not self.vec:
+            return self.dense
+        return np.concatenate(
+            [self.dense] + [self.vec[f][self.vec_rep[f]] for f in self.vec], axis=1
         )
 
     def select(self, rows: np.ndarray) -> "FeatureMatrix":
@@ -92,16 +124,53 @@ class FeatureMatrix:
             dense_names=self.dense_names,
             cat={k: v[rows] for k, v in self.cat.items()},
             cat_sizes=self.cat_sizes,
-            bag_idx={k: v[rows] for k, v in self.bag_idx.items()},
-            bag_val={k: v[rows] for k, v in self.bag_val.items()},
+            bag_idx={
+                k: (v if k in self.bag_rep else v[rows])
+                for k, v in self.bag_idx.items()
+            },
+            bag_val={
+                k: (v if k in self.bag_rep else v[rows])
+                for k, v in self.bag_val.items()
+            },
             bag_sizes=self.bag_sizes,
+            vec=self.vec,
+            vec_rep={k: v[rows] for k, v in self.vec_rep.items()},
+            bag_rep={k: v[rows] for k, v in self.bag_rep.items()},
         )
+
+    def expanded_bag(self, f: str) -> tuple[np.ndarray, np.ndarray]:
+        """The per-row (N, L) ``(idx, val)`` view of a bag field, whether it
+        is stored factored or per-row."""
+        idx, val = self.bag_idx[f], self.bag_val[f]
+        rep = self.bag_rep.get(f)
+        if rep is None:
+            return idx, val
+        return idx[rep], val[rep]
+
+    def flat_bags(self) -> dict[str, tuple]:
+        """Per bag field, the row-major flat entries ``(rows, vocab, vals)``
+        of the STORED arrays — distinct-document rows for factored fields
+        (``bag_rep``), per-data rows otherwise. Memoized, because both the
+        device batch layout and the standardization moments need it (two
+        full passes over ~100M-element masks at bench scale otherwise)."""
+        cached = self.__dict__.get("_flat_bag_cache")
+        if cached is None:
+            cached = {}
+            for f in self.bag_idx:
+                idx, val = self.bag_idx[f], self.bag_val[f]
+                ok = idx >= 0
+                rows = np.broadcast_to(
+                    np.arange(idx.shape[0], dtype=np.int64)[:, None], idx.shape
+                )[ok]
+                cached[f] = (rows, idx[ok].astype(np.int32), val[ok].astype(np.float32))
+            self.__dict__["_flat_bag_cache"] = cached
+        return cached
 
     def to_dense(self) -> np.ndarray:
         """Materialize the flat one-hot layout (tests / small data only):
         [dense | one-hot(cat fields) | multi-hot(bag fields)]."""
         n = self.n_rows
-        out = [self.dense]
+        out = [self.expanded_dense()]
         for name in self.cat:
             block = np.zeros((n, self.cat_sizes[name]), dtype=np.float32)
             idx = self.cat[name]
@@ -110,7 +179,7 @@ class FeatureMatrix:
             out.append(block)
         for name in self.bag_idx:
             block = np.zeros((n, self.bag_sizes[name]), dtype=np.float32)
-            idx, val = self.bag_idx[name], self.bag_val[name]
+            idx, val = self.expanded_bag(name)
             rows = np.repeat(np.arange(n), idx.shape[1]).reshape(idx.shape)
             ok = idx >= 0
             np.add.at(block, (rows[ok], idx[ok]), val[ok])
@@ -152,15 +221,19 @@ class FeatureAssemblerModel(Transformer):
                 .reshape(n, 1)
             )
             names.append(c)
+        vec, vec_rep = {}, {}
         for c in self.vector_cols:
             self.require_cols(df, [c])
             if n:
                 rep, (uniq,) = _dedup_rows(col_values(df[c]))
-                vecs = np.stack([np.asarray(v, dtype=np.float32) for v in uniq])[rep]
+                vec[c] = np.stack([np.asarray(v, dtype=np.float32) for v in uniq])
+                vec_rep[c] = rep.astype(np.int32)
             else:
-                vecs = np.zeros((0, 0), np.float32)
-            blocks.append(vecs)
-            names.extend(f"{c}[{i}]" for i in range(vecs.shape[1]))
+                vec[c] = np.zeros((0, 0), np.float32)
+                vec_rep[c] = np.zeros((0,), np.int32)
+            # Stored factored (distinct vectors + rep), not expanded — the
+            # expanded copy is what made the r4 LR batch 657 MB.
+            names.extend(f"{c}[{i}]" for i in range(vec[c].shape[1]))
         dense = (
             np.concatenate(blocks, axis=1)
             if blocks
@@ -175,14 +248,16 @@ class FeatureAssemblerModel(Transformer):
             # encoded; clip runaway values defensively.
             cat[c] = np.clip(idx, 0, size - 1).astype(np.int32)
 
-        bag_idx, bag_val = {}, {}
+        bag_idx, bag_val, bag_rep = {}, {}, {}
         for c, size in self.bag_sizes.items():
             ic, vc = f"{c}__bag_idx", f"{c}__bag_val"
             self.require_cols(df, [ic, vc])
             pad = self.bag_pad[c]
             # Pad each DISTINCT bag once (identity dedup over the memoized
-            # per-document arrays), scatter flat, gather rows — no per-row
-            # Python assignment.
+            # per-document arrays) and KEEP the factored (distinct, rep)
+            # form: the expanded copy repeats each user/repo document across
+            # ~50-80 rows, multiplying every downstream host pass and device
+            # gather by that factor.
             rep, (u_i, u_v) = _dedup_rows(col_values(df[ic]), col_values(df[vc]))
             u = len(u_i)
             lens = np.fromiter((min(len(a), pad) for a in u_i), np.int64, count=u)
@@ -198,8 +273,9 @@ class FeatureAssemblerModel(Transformer):
                     [np.asarray(a[:t], dtype=np.float32) for a, t in zip(u_v, lens)]
                 )
             # -1 rows stay fully masked; real gathers happen on device.
-            bag_idx[c] = idx[rep]
-            bag_val[c] = val[rep]
+            bag_idx[c] = idx
+            bag_val[c] = val
+            bag_rep[c] = rep.astype(np.int32)
 
         return FeatureMatrix(
             dense=dense,
@@ -209,6 +285,9 @@ class FeatureAssemblerModel(Transformer):
             bag_idx=bag_idx,
             bag_val=bag_val,
             bag_sizes=dict(self.bag_sizes),
+            vec=vec,
+            vec_rep=vec_rep,
+            bag_rep=bag_rep,
         )
 
 

@@ -57,19 +57,30 @@ that's there's what's when's where's who's why's would""".split()
 
 
 def _cjk_unigrams(run: str) -> list[str]:
+    """Character-unigram fallback segmenter (the r1-r4 default)."""
     return list(run)
 
 
 class Tokenizer(Transformer):
-    """CJK-aware tokenizer over a string column -> list-of-tokens column."""
+    """CJK-aware tokenizer over a string column -> list-of-tokens column.
+
+    CJK runs go through ``segmenter``: by default the built-in
+    frequency-dictionary Viterbi segmenter
+    (``features/cjk_segmenter.py`` — the HanLP-parity word-level behavior,
+    ``transformers/HanLPTokenizer.scala:29-51``); pass ``_cjk_unigrams`` for
+    character unigrams or any custom callable."""
 
     def __init__(
         self,
         input_col: str,
         output_col: str | None = None,
         remove_stop_words: bool = True,
-        segmenter: Callable[[str], list[str]] = _cjk_unigrams,
+        segmenter: Callable[[str], list[str]] | None = None,
     ):
+        if segmenter is None:
+            from albedo_tpu.features.cjk_segmenter import default_segmenter
+
+            segmenter = default_segmenter()
         self.input_col = input_col
         self.output_col = output_col or f"{input_col}__words"
         self.remove_stop_words = remove_stop_words

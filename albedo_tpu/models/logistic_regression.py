@@ -51,9 +51,12 @@ class LogisticRegressionModel:
     # L-BFGS iterations actually executed (None for the adam solver) — the
     # convergence diagnostic MLlib exposes via its training summary.
     n_iter_run: int | None = None
-    # Wall-clock split of the fit: XLA compile (0 when the in-process
-    # executable cache was warm — see _aot_call) vs the actual solve. The r4
-    # ranker bench conflated the two inside its lr_fit stage (VERDICT r4 #1).
+    # Wall-clock split of the fit: host batch/scales preparation (flat
+    # layouts, standardization moments, upload dispatch), XLA compile (0 when
+    # the in-process executable cache was warm — see _aot_call), and the
+    # actual solve. The r4 ranker bench conflated all three inside its
+    # lr_fit stage (VERDICT r4 #1).
+    prep_s: float | None = None
     compile_s: float | None = None
     run_s: float | None = None
 
@@ -95,13 +98,15 @@ class LogisticRegression:
 
     def _prepare_scales(self, fm: FeatureMatrix):
         """(scales, center) under the configured standardization — shared by
-        ``fit`` and ``fit_many`` so grid and single fits can never drift."""
+        ``fit`` and ``fit_many`` so grid and single fits can never drift.
+        Host arrays: they upload as jit-call arguments (eager per-field
+        jnp conversions each cost a tunneled dispatch)."""
         if self.standardization:
-            scales = jax.tree.map(jnp.asarray, inverse_std_scales(fm))
-            center = jnp.asarray(dense_center(fm))
+            scales = inverse_std_scales(fm)
+            center = dense_center(fm)
         else:
-            scales = jax.tree.map(lambda p: jnp.ones_like(p), init_params(fm))
-            scales["bias"] = jnp.float32(1.0)
+            scales = jax.tree.map(np.ones_like, init_params(fm))
+            scales["bias"] = np.float32(1.0)
             center = None
         return scales, center
 
@@ -112,6 +117,7 @@ class LogisticRegression:
         sample_weight: np.ndarray | None = None,
     ) -> LogisticRegressionModel:
         n = fm.n_rows
+        t_prep = time.perf_counter()
         if sample_weight is None:
             sample_weight = np.ones(n, dtype=np.float32)
         if self.mesh is not None:
@@ -125,6 +131,7 @@ class LogisticRegression:
 
         scales, center = self._prepare_scales(fm)
         params = init_params(fm)
+        prep_s = time.perf_counter() - t_prep
 
         n_iter_run = None
         compile_s = run_s = None
@@ -158,7 +165,7 @@ class LogisticRegression:
         return LogisticRegressionModel(
             params=params, scales=scales, train_loss=float(loss),
             center=None if center is None else np.asarray(center),
-            n_iter_run=n_iter_run, compile_s=compile_s, run_s=run_s,
+            n_iter_run=n_iter_run, prep_s=prep_s, compile_s=compile_s, run_s=run_s,
         )
 
     def fit_many(
@@ -190,10 +197,12 @@ class LogisticRegression:
         n_grid = ws.shape[0]
         if n_grid == 0:
             raise ValueError("sample_weights must have at least one grid row")
+        t_prep = time.perf_counter()
         batch = feature_batch(fm)
         y = jnp.asarray(labels, dtype=jnp.float32)
         scales, center = self._prepare_scales(fm)
         params0 = init_params(fm)
+        prep_s = time.perf_counter() - t_prep
 
         if grid_mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
@@ -228,6 +237,7 @@ class LogisticRegression:
                 train_loss=float(losses[g]),
                 center=center_np,
                 n_iter_run=int(n_dones[g]),
+                prep_s=prep_s,
                 compile_s=compile_s,
                 run_s=run_s,
             )

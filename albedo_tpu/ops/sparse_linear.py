@@ -41,20 +41,32 @@ def feature_batch(fm: FeatureMatrix) -> dict[str, jnp.ndarray]:
     at bench scale). The flat layout carries a row-sorted copy (+ row indptr)
     for the forward and a vocab-sorted copy (+ vocab indptr) for the weight
     gradient, so BOTH directions reduce by the cumsum-difference trick over
-    only the real entries (``_bag_term``) — no scatter at all. The mesh path
-    (``parallel.lr.shard_feature_batch``) keeps the padded layout — a
-    row-shardable rectangle — and ``block_logits`` consumes either.
+    only the real entries (``_bag_term``) — no scatter at all.
+
+    Vector (embedding) fields upload FACTORED: the (U, D) distinct vectors,
+    the (N,) rep gather, and a rep-sorted order + indptr so the backward of
+    the per-row gather is a cumsum-difference segment sum (``_rep_term``),
+    not a TPU scatter-add. The mesh path
+    (``parallel.lr.shard_feature_batch``) keeps the padded/expanded layout —
+    a row-shardable rectangle — and ``block_logits`` consumes either.
     """
     batch: dict[str, jnp.ndarray] = {"dense": jnp.asarray(fm.dense)}
+    for f in fm.vec:
+        rep, order, indptr = _rep_layout(fm.vec_rep[f], fm.vec[f].shape[0])
+        batch[f"vecflat:{f}:vec"] = jnp.asarray(fm.vec[f])
+        batch[f"vecflat:{f}:rep"] = jnp.asarray(rep)
+        batch[f"vecflat:{f}:order"] = jnp.asarray(order)
+        batch[f"vecflat:{f}:indptr"] = jnp.asarray(indptr)
     for f, v in fm.cat.items():
         batch[f"cat:{f}"] = jnp.asarray(v)
+    flat = fm.flat_bags()
     for f in fm.bag_idx:
-        idx, val = fm.bag_idx[f], fm.bag_val[f]
-        n = idx.shape[0]
-        ok = idx >= 0
-        rows = np.broadcast_to(np.arange(n, dtype=np.int64)[:, None], idx.shape)[ok]
-        vocab = idx[ok].astype(np.int32)
-        vals = val[ok].astype(np.float32)
+        rows, vocab, vals = flat[f]
+        # Flats are over the STORED rows — the ~50-80x smaller distinct-
+        # document set for factored fields (fm.bag_rep), whose per-distinct
+        # sums expand to data rows through the same _rep_term machinery as
+        # the vec fields (the two custom VJPs compose under autodiff).
+        n = fm.bag_idx[f].shape[0]
         order = np.argsort(vocab, kind="stable")
         # Vocab indptr spans the FULL weight table, so the backward
         # cumsum-difference yields a gradient shaped exactly like the table.
@@ -69,18 +81,41 @@ def feature_batch(fm: FeatureMatrix) -> dict[str, jnp.ndarray]:
         batch[f"bagflat:{f}:v_rows"] = jnp.asarray(rows[order].astype(np.int32))
         batch[f"bagflat:{f}:v_val"] = jnp.asarray(vals[order])          # vocab-sorted
         batch[f"bagflat:{f}:v_indptr"] = jnp.asarray(v_indptr)
+        bag_rep = fm.bag_rep.get(f)
+        if bag_rep is not None:
+            rep, rorder, rindptr = _rep_layout(bag_rep, n)
+            batch[f"bagrep:{f}:rep"] = jnp.asarray(rep)
+            batch[f"bagrep:{f}:order"] = jnp.asarray(rorder)
+            batch[f"bagrep:{f}:indptr"] = jnp.asarray(rindptr)
     return batch
 
 
+def _rep_layout(rep: np.ndarray, n_distinct: int) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """The ``_rep_term`` input layout for a (N,) rep vector: ``(rep int32,
+    rep-sorted row order, (n_distinct+1,) segment indptr)`` — shared by the
+    vec and factored-bag feeders so the two gather VJP layouts cannot drift."""
+    rep = np.asarray(rep).astype(np.int32)
+    order = np.argsort(rep, kind="stable").astype(np.int32)
+    indptr = np.zeros(n_distinct + 1, np.int32)
+    np.cumsum(np.bincount(rep, minlength=n_distinct), out=indptr[1:])
+    return rep, order, indptr
+
+
 def init_params(fm: FeatureMatrix) -> Params:
+    # Host-side zeros: they ride to the device as jit-call arguments. Eager
+    # jnp.zeros would cost one tunneled dispatch per field (~70 ms each,
+    # ~3 s at ranker scale).
     p: Params = {
-        "bias": jnp.zeros((), jnp.float32),
-        "dense": jnp.zeros((fm.dense.shape[1],), jnp.float32),
+        "bias": np.float32(0.0),
+        # One flat coefficient vector for the LOGICAL dense block
+        # [scalars | vec fields] — the factored storage changes the batch
+        # layout only, never the parameter/scales/coefficients structure.
+        "dense": np.zeros((fm.dense_width,), np.float32),
     }
     for f, size in fm.cat_sizes.items():
-        p[f"cat:{f}"] = jnp.zeros((size,), jnp.float32)
+        p[f"cat:{f}"] = np.zeros((size,), np.float32)
     for f, size in fm.bag_sizes.items():
-        p[f"bag:{f}"] = jnp.zeros((size,), jnp.float32)
+        p[f"bag:{f}"] = np.zeros((size,), np.float32)
     return p
 
 
@@ -100,29 +135,59 @@ def inverse_std_scales(fm: FeatureMatrix) -> Params:
         return np.where(std > 0, 1.0 / np.maximum(std, 1e-12), 0.0).astype(np.float32)
 
     scales: Params = {"bias": np.float32(1.0)}
-    d = fm.dense.astype(np.float64)
-    std = d.std(axis=0, ddof=1) if n > 1 else d.std(axis=0)
-    scales["dense"] = inv(std)
+    ddof = 1 if n > 1 else 0
+    # Scalar block: f64 ACCUMULATION without materializing an f64 copy (the
+    # astype copied 1.3 GB at r5 ranker bench scale).
+    std_parts = [fm.dense.std(axis=0, dtype=np.float64, ddof=ddof)]
+    for f in fm.vec:
+        # Factored vec field: moments of the EXPANDED column are count-
+        # weighted moments over the distinct vectors — O(U*D), not O(N*D).
+        v = fm.vec[f].astype(np.float64)
+        counts = np.bincount(fm.vec_rep[f], minlength=v.shape[0]).astype(np.float64)
+        mean = counts @ v / n
+        var = counts @ (v**2) / n - mean**2
+        if ddof:
+            var = var * (n / (n - 1))
+        std_parts.append(np.sqrt(np.maximum(var, 0)))
+    scales["dense"] = inv(np.concatenate(std_parts) if len(std_parts) > 1 else std_parts[0])
     for f, size in fm.cat_sizes.items():
         p = np.bincount(fm.cat[f], minlength=size) / n
         scales[f"cat:{f}"] = inv(np.sqrt(p * (1 - p) * bessel))
+    flat = fm.flat_bags()
     for f, size in fm.bag_sizes.items():
-        idx, val = fm.bag_idx[f], fm.bag_val[f]
-        ok = idx >= 0
-        rows = np.broadcast_to(np.arange(fm.n_rows)[:, None], idx.shape)[ok]
-        cols = idx[ok].astype(np.int64)
-        vals = val[ok].astype(np.float64)
-        # Aggregate duplicate indices within a row first: the expanded column
-        # value is the SUM of a row's entries for that index, so moments must
-        # be taken over per-(row, col) sums.
-        key = rows.astype(np.int64) * size + cols
-        order = np.argsort(key, kind="stable")
-        key_s, vals_s = key[order], vals[order]
-        uniq, start = np.unique(key_s, return_index=True)
-        agg = np.add.reduceat(vals_s, start) if start.size else np.zeros(0)
-        col_of = uniq % size
-        s1 = np.bincount(col_of, weights=agg, minlength=size)
-        s2 = np.bincount(col_of, weights=agg**2, minlength=size)
+        rows, cols, vals64 = flat[f]
+        cols = cols.astype(np.int64)
+        vals = vals64.astype(np.float64)
+        # Factored fields store one row per DISTINCT document; the expanded
+        # moments weight each distinct row by its multiplicity.
+        rep = fm.bag_rep.get(f)
+        if rep is None:
+            mult = None
+        else:
+            mult = np.bincount(rep, minlength=fm.bag_idx[f].shape[0]).astype(np.float64)
+        # The expanded column value is the SUM of a row's entries for that
+        # index, so moments must be over per-(row, col) sums. Entries are
+        # row-major; when indices are sorted-unique within each row (what
+        # CountVectorizer emits) the O(n) adjacency check proves there is
+        # nothing to aggregate and the key-sort pass is skipped entirely.
+        same_row = rows[1:] == rows[:-1]
+        within_sorted = not np.any(same_row & (cols[1:] < cols[:-1]))
+        has_dup = within_sorted and bool(np.any(same_row & (cols[1:] == cols[:-1])))
+        if within_sorted and not has_dup:
+            w1 = vals if mult is None else vals * mult[rows]
+            w2 = vals**2 if mult is None else vals**2 * mult[rows]
+            s1 = np.bincount(cols, weights=w1, minlength=size)
+            s2 = np.bincount(cols, weights=w2, minlength=size)
+        else:
+            key = rows.astype(np.int64) * size + cols
+            order = np.argsort(key, kind="stable")
+            key_s, vals_s = key[order], vals[order]
+            uniq, start = np.unique(key_s, return_index=True)
+            agg = np.add.reduceat(vals_s, start) if start.size else np.zeros(0)
+            col_of = uniq % size
+            m_of = 1.0 if mult is None else mult[uniq // size]
+            s1 = np.bincount(col_of, weights=agg * m_of, minlength=size)
+            s2 = np.bincount(col_of, weights=agg**2 * m_of, minlength=size)
         mean = s1 / n
         var = (s2 / n - mean**2) * bessel
         scales[f"bag:{f}"] = inv(np.sqrt(np.maximum(var, 0)))
@@ -140,7 +205,13 @@ def dense_center(fm: FeatureMatrix) -> np.ndarray:
     unchanged (the bias absorbs the shift) and the L2 penalty still applies to
     the same standardized coefficients.
     """
-    return fm.dense.astype(np.float64).mean(axis=0).astype(np.float32)
+    n = max(1, fm.n_rows)
+    parts = [fm.dense.mean(axis=0, dtype=np.float64)]
+    for f in fm.vec:
+        counts = np.bincount(fm.vec_rep[f], minlength=fm.vec[f].shape[0])
+        parts.append(counts.astype(np.float64) @ fm.vec[f].astype(np.float64) / n)
+    out = np.concatenate(parts) if len(parts) > 1 else parts[0]
+    return out.astype(np.float32)
 
 
 def _segment_sums(data: jnp.ndarray, indptr: jnp.ndarray) -> jnp.ndarray:
@@ -183,6 +254,33 @@ def _bag_term(
     return term(w)
 
 
+def _rep_term(
+    lu: jnp.ndarray,          # (U,) per-distinct-vector logit contributions
+    rep: jnp.ndarray,         # (N,) representative index per row
+    order: jnp.ndarray,       # (N,) row indices sorted by rep
+    indptr: jnp.ndarray,      # (U+1,) rep segment boundaries in `order`
+) -> jnp.ndarray:
+    """Expand per-distinct values to rows with a segment-sum VJP.
+
+    Forward: the (N,) gather ``lu[rep]``. Backward wrt ``lu``: plain autodiff
+    would emit a scatter-add over N rows into U slots (TPU scatters measured
+    ~100x slower than streaming); the rep-sorted order + indptr reduce it to
+    the same cumsum-difference trick as the bag fields."""
+
+    @jax.custom_vjp
+    def term(lu):
+        return lu[rep]
+
+    def fwd(lu):
+        return term(lu), None
+
+    def bwd(_, g):
+        return (_segment_sums(g[order], indptr),)
+
+    term.defvjp(fwd, bwd)
+    return term(lu)
+
+
 def block_logits(
     params: Params,
     scales: Params,
@@ -193,10 +291,35 @@ def block_logits(
     ``scales`` the per-feature 1/std factors (use all-ones for raw space).
     ``center`` (optional) is subtracted from the dense block before scaling.
 
-    Bag fields arrive either flat-dual-sorted (``feature_batch``; fast VJP)
-    or padded (``parallel.lr.shard_feature_batch``; row-shardable)."""
-    dense = batch["dense"] if center is None else batch["dense"] - center
-    logits = params["bias"] + (dense * scales["dense"]) @ params["dense"]
+    The logical dense block is [scalars | vec fields]; ``params["dense"]``
+    and ``scales["dense"]`` span the full width. When the batch carries
+    factored ``vecflat:`` fields (``feature_batch``), each field's term is
+    computed per DISTINCT vector — O(U*D) instead of O(N*D) — then expanded
+    by a gather; the expanded layout (``shard_feature_batch``) computes the
+    same affine form directly. Bag fields likewise arrive flat-dual-sorted
+    (fast VJP) or padded (row-shardable)."""
+    w_dense = params["dense"] * scales["dense"]
+    d_scalar = batch["dense"].shape[1]
+    dense = batch["dense"] if center is None else batch["dense"] - center[:d_scalar]
+    logits = params["bias"] + dense @ w_dense[:d_scalar]
+    off = d_scalar
+    for key, arr in batch.items():
+        if key.startswith("vecflat:") and key.endswith(":vec"):
+            f = key[len("vecflat:"):-len(":vec")]
+            d = arr.shape[1]
+            w_f = w_dense[off:off + d]
+            # Center BEFORE the contraction: ``vec @ w - c @ w`` cancels two
+            # large near-equal dots per distinct vector (w2v dims are
+            # near-constant — the exact conditioning problem dense_center
+            # exists for; computing it the cancelling way sent the r5 bench
+            # fit from 31 to 163 L-BFGS iterations).
+            vals = arr if center is None else arr - center[off:off + d]
+            lu = vals @ w_f
+            p = f"vecflat:{f}:"
+            logits = logits + _rep_term(
+                lu, batch[p + "rep"], batch[p + "order"], batch[p + "indptr"]
+            )
+            off += d
     for key, arr in batch.items():
         if key.startswith("cat:"):
             f = key[len("cat:"):]
@@ -206,11 +329,19 @@ def block_logits(
             f = key[len("bagflat:"):-len(":r_vocab")]
             w = params[f"bag:{f}"] * scales[f"bag:{f}"]
             p = f"bagflat:{f}:"
-            logits = logits + _bag_term(
+            term = _bag_term(
                 w,
                 batch[p + "r_vocab"], batch[p + "r_val"], batch[p + "r_indptr"],
                 batch[p + "v_rows"], batch[p + "v_val"], batch[p + "v_indptr"],
             )
+            rp = f"bagrep:{f}:"
+            if rp + "rep" in batch:
+                # Factored field: `term` is per DISTINCT document; expand to
+                # data rows (the two custom VJPs compose under autodiff).
+                term = _rep_term(
+                    term, batch[rp + "rep"], batch[rp + "order"], batch[rp + "indptr"]
+                )
+            logits = logits + term
         elif key.startswith("bag_idx:"):
             f = key[len("bag_idx:"):]
             w = params[f"bag:{f}"] * scales[f"bag:{f}"]

@@ -39,12 +39,18 @@ def shard_feature_batch(
     def put(x: np.ndarray, fill=0):
         return jax.device_put(pad_rows_to(np.asarray(x), n_shards, fill=fill), sharding)
 
-    batch = {"dense": put(fm.dense.astype(np.float32))}
+    # Expanded dense block: the row-sharded rectangle every device slices
+    # evenly (the factored vec layout would replicate the distinct vectors
+    # and shard only the rep gather — a later optimization; parity with the
+    # single-device fit is what matters here, and params/scales span the
+    # same logical width either way).
+    batch = {"dense": put(fm.expanded_dense().astype(np.float32))}
     for f, v in fm.cat.items():
         batch[f"cat:{f}"] = put(v)
     for f in fm.bag_idx:
-        batch[f"bag_idx:{f}"] = put(fm.bag_idx[f], fill=-1)
-        batch[f"bag_val:{f}"] = put(fm.bag_val[f])
+        idx, val = fm.expanded_bag(f)  # per-row view of factored fields
+        batch[f"bag_idx:{f}"] = put(idx, fill=-1)
+        batch[f"bag_val:{f}"] = put(val)
     y = put(np.asarray(labels, dtype=np.float32))
     w = put(np.asarray(weights, dtype=np.float32))
     return batch, y, w

@@ -1,0 +1,50 @@
+"""Persistent XLA-executable cache across processes.
+
+The reference's JVM warms its code cache within one long-lived Spark session;
+a JAX job pays XLA compilation again in every fresh process (~47 s for the
+ranker's L-BFGS executable on the tunneled backend, r5 measurement). JAX's
+persistent compilation cache serializes compiled executables to disk keyed by
+HLO fingerprint, so repeat runs (the ``loadOrCreate`` philosophy,
+``utils/ModelUtils.scala:7-21``, applied to executables) skip the compile:
+measured working on the axon remote-compile backend (second process ~2x
+faster on a toy program; the full LR executable drops from ~47 s to ~0).
+
+Disable with ``ALBEDO_JAX_CACHE=0``; the default directory lives beside the
+artifact store (``ALBEDO_DATA_DIR``), so ``drop_data``-style cleanup removes
+both.
+"""
+
+from __future__ import annotations
+
+import os
+from pathlib import Path
+
+_ENABLED = False
+
+
+def enable_persistent_compilation_cache(cache_dir: str | Path | None = None) -> bool:
+    """Idempotently point JAX's persistent compilation cache at a directory.
+
+    Returns True if the cache is active after the call. Respects an existing
+    user-set ``jax_compilation_cache_dir`` and the ``ALBEDO_JAX_CACHE=0``
+    kill switch.
+    """
+    global _ENABLED
+    if os.environ.get("ALBEDO_JAX_CACHE", "1") == "0":
+        return False
+    import jax
+
+    if _ENABLED or jax.config.jax_compilation_cache_dir:
+        _ENABLED = True
+        return True
+    if cache_dir is None:
+        from albedo_tpu.settings import get_settings
+
+        cache_dir = get_settings().data_dir / "jax-cache"
+    Path(cache_dir).mkdir(parents=True, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", str(cache_dir))
+    # Executables this small recompile faster than they deserialize; only
+    # persist genuinely expensive compiles.
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
+    _ENABLED = True
+    return True

@@ -1,0 +1,96 @@
+"""Dictionary-driven CJK segmentation: word-level tokens through the text
+stack (HanLP parity — ``transformers/HanLPTokenizer.scala:29-51``)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from albedo_tpu.features.cjk_segmenter import (
+    DictionarySegmenter,
+    default_dictionary,
+    segment,
+)
+from albedo_tpu.features.text import CountVectorizer, Tokenizer, _cjk_unigrams
+
+
+def test_dictionary_words_stay_whole():
+    assert segment("机器学习框架") == ["机器学习", "框架"]
+    assert segment("深度学习教程") == ["深度学习", "教程"]
+    assert segment("数据库管理工具") == ["数据库", "管理", "工具"]
+
+
+def test_frequency_resolves_ambiguity():
+    # 中文 + 文档 overlap on 文; the Viterbi path picks by frequency, and
+    # both dictionary words must survive somewhere in the output.
+    out = segment("中文文档")
+    assert out == ["中文", "文档"]
+
+
+def test_oov_falls_back_to_single_chars_and_covers_input():
+    text = "饕餮盛宴"  # rare characters, not in the dictionary
+    out = segment(text)
+    assert "".join(out) == text
+    assert all(len(t) == 1 for t in out)
+
+
+def test_mixed_known_unknown():
+    out = segment("魑魅框架")
+    assert out[-1] == "框架"
+    assert "".join(out) == "魑魅框架"
+
+
+def test_extra_words_extend_dictionary():
+    base = DictionarySegmenter()
+    assert base("甄嬛传") != ["甄嬛传"]
+    ext = DictionarySegmenter(extra_words=["甄嬛传"])
+    assert ext("甄嬛传") == ["甄嬛传"]
+
+
+def test_tokenizer_default_is_word_level():
+    tok = Tokenizer("text")
+    out = tok.tokenize("一个机器学习框架 for python")
+    assert "机器学习" in out and "框架" in out and "python" in out
+    # unigram hook still available
+    uni = Tokenizer("text", segmenter=_cjk_unigrams)
+    out_u = uni.tokenize("机器学习框架")
+    assert "机" in out_u and "机器学习" not in out_u
+
+
+def test_vocab_word_level_vs_unigrams_through_count_vectorizer():
+    docs = [
+        "高性能机器学习框架",
+        "深度学习模型训练工具",
+        "机器学习入门教程",
+        "分布式数据库系统",
+    ]
+    df = pd.DataFrame({"text": docs})
+    word_df = Tokenizer("text").transform(df)
+    uni_df = Tokenizer("text", segmenter=_cjk_unigrams).transform(df)
+    cv_w = CountVectorizer("text__words", "cv", min_df=1).fit(word_df)
+    cv_u = CountVectorizer("text__words", "cv", min_df=1).fit(uni_df)
+    assert "机器学习" in cv_w.vocab and "框架" in cv_w.vocab
+    assert "机器学习" not in cv_u.vocab  # unigram vocab is characters
+    # word-level vocabulary is materially different (and more compact than
+    # the padded unigram streams for the same text)
+    assert set(cv_w.vocab) != set(cv_u.vocab)
+
+
+def test_w2v_trains_on_word_level_tokens():
+    from albedo_tpu.models.word2vec import Word2Vec
+
+    rng = np.random.default_rng(0)
+    base = ["机器学习 框架 训练 模型", "深度学习 模型 训练", "数据库 系统 存储"]
+    docs = [base[rng.integers(0, 3)] for _ in range(60)]
+    df = pd.DataFrame({"text": docs})
+    toked = Tokenizer("text").transform(df)
+    w2v = Word2Vec(input_col="text__words", dim=8, max_iter=2, min_count=2, seed=0)
+    model = w2v.fit(toked)
+    assert "机器学习" in model.vocab
+    vec = model.vector("机器学习")
+    assert vec.shape == (8,) and np.isfinite(vec).all()
+
+
+def test_default_dictionary_sane():
+    d = default_dictionary()
+    assert len(d) > 250
+    assert all(v > 0 for v in d.values())

@@ -269,7 +269,10 @@ def test_feature_assembler_blocks_and_dense_equivalence():
     ).fit(feat_df)
     fm = asm.assemble(feat_df)
 
-    assert fm.dense.shape == (3, 4)  # num, flag, vec[0], vec[1]
+    assert fm.dense.shape == (3, 2)          # scalar block: num, flag
+    assert fm.dense_width == 4               # + factored vec[0], vec[1]
+    assert fm.expanded_dense().shape == (3, 4)
+    assert fm.vec["vec"].shape[1] == 2 and fm.vec_rep["vec"].shape == (3,)
     assert fm.cat["cat__idx"].tolist() == [0, 1, 0]
     assert fm.cat_sizes["cat__idx"] == 3  # x, y, unknown slot
     assert fm.bag_sizes["words__cv"] == 2

@@ -332,3 +332,78 @@ def test_bag_flat_path_matches_padded_path():
         np.testing.assert_allclose(
             np.asarray(g_flat[k]), np.asarray(g_pad[k]), rtol=1e-4, atol=1e-5,
         )
+
+
+def test_factored_vec_fit_matches_expanded(rng):
+    """The factored vec layout (distinct vectors + rep gather, _rep_term VJP)
+    must reproduce the expanded-dense fit: same loss, same predictions, same
+    raw-space coefficients."""
+    n, u, d_vec = 400, 12, 5
+    vec = rng.normal(size=(u, d_vec)).astype(np.float32)
+    rep = rng.integers(0, u, n).astype(np.int32)
+    scalars = rng.normal(size=(n, 2)).astype(np.float32)
+    y = (scalars[:, 0] + vec[rep][:, 0] + rng.normal(scale=0.2, size=n) > 0).astype(np.float32)
+
+    factored = FeatureMatrix(
+        dense=scalars, dense_names=["a", "b"] + [f"v[{i}]" for i in range(d_vec)],
+        cat={}, cat_sizes={}, bag_idx={}, bag_val={}, bag_sizes={},
+        vec={"v": vec}, vec_rep={"v": rep},
+    )
+    expanded = FeatureMatrix(
+        dense=np.concatenate([scalars, vec[rep]], axis=1),
+        dense_names=factored.dense_names,
+        cat={}, cat_sizes={}, bag_idx={}, bag_val={}, bag_sizes={},
+    )
+    assert factored.dense_width == expanded.dense.shape[1]
+    np.testing.assert_array_equal(factored.expanded_dense(), expanded.dense)
+
+    m_f = LogisticRegression(max_iter=80).fit(factored, y)
+    m_e = LogisticRegression(max_iter=80).fit(expanded, y)
+    assert abs(m_f.train_loss - m_e.train_loss) < 1e-4, (m_f.train_loss, m_e.train_loss)
+    np.testing.assert_allclose(
+        m_f.predict_proba(factored), m_e.predict_proba(expanded), atol=1e-3
+    )
+    np.testing.assert_allclose(
+        m_f.coefficients["dense"], m_e.coefficients["dense"], atol=5e-3
+    )
+
+
+def test_factored_bag_fit_matches_per_row(rng):
+    """Factored bag storage (distinct documents + rep; _bag_term composed
+    with _rep_term) must reproduce the per-row bag fit exactly."""
+    n, u_docs, v = 400, 9, 20
+    doc_idx = np.sort(rng.integers(0, v, (u_docs, 4)).astype(np.int32), axis=1)
+    # make within-doc indices unique to keep the to_dense semantics simple
+    for r in range(u_docs):
+        doc_idx[r] = np.sort(rng.choice(v, 4, replace=False)).astype(np.int32)
+    doc_val = rng.integers(1, 4, (u_docs, 4)).astype(np.float32)
+    rep = rng.integers(0, u_docs, n).astype(np.int32)
+    dense = rng.normal(size=(n, 2)).astype(np.float32)
+    y = (dense[:, 0] + (rep % 3 == 0) + rng.normal(scale=0.3, size=n) > 0.5).astype(np.float32)
+
+    factored = FeatureMatrix(
+        dense=dense, dense_names=["a", "b"], cat={}, cat_sizes={},
+        bag_idx={"b": doc_idx}, bag_val={"b": doc_val}, bag_sizes={"b": v},
+        bag_rep={"b": rep},
+    )
+    per_row = FeatureMatrix(
+        dense=dense, dense_names=["a", "b"], cat={}, cat_sizes={},
+        bag_idx={"b": doc_idx[rep]}, bag_val={"b": doc_val[rep]}, bag_sizes={"b": v},
+    )
+    np.testing.assert_array_equal(factored.to_dense(), per_row.to_dense())
+    np.testing.assert_array_equal(
+        factored.select(np.arange(0, n, 3)).to_dense(),
+        per_row.select(np.arange(0, n, 3)).to_dense(),
+    )
+
+    from albedo_tpu.ops.sparse_linear import inverse_std_scales
+    s_f = inverse_std_scales(factored)
+    s_p = inverse_std_scales(per_row)
+    np.testing.assert_allclose(s_f["bag:b"], s_p["bag:b"], rtol=1e-6)
+
+    m_f = LogisticRegression(max_iter=60).fit(factored, y)
+    m_p = LogisticRegression(max_iter=60).fit(per_row, y)
+    assert abs(m_f.train_loss - m_p.train_loss) < 1e-5
+    np.testing.assert_allclose(
+        m_f.predict_proba(factored), m_p.predict_proba(per_row), atol=1e-3
+    )
