@@ -222,6 +222,7 @@ def train_ranker(
     timer=None,
     weight_cols: Sequence[str] | None = None,
     grid_mesh=None,
+    lr_mesh=None,
 ) -> RankerResult:
     """End-to-end ranker training + evaluation (SURVEY.md §3.2).
 
@@ -234,6 +235,10 @@ def train_ranker(
     is fit once per weight column in a single vmapped L-BFGS solve
     (optionally grid-sharded over ``grid_mesh``), each scored by AUC; the best
     column's model continues into fusion/NDCG and the full grid is returned.
+
+    ``lr_mesh`` lays the LR training batch out row-sharded over the mesh's
+    data axis (``parallel.lr``) — the end-to-end sharded ranker path: XLA
+    inserts the ICI psums that replace MLlib LR's gradient treeAggregate.
     """
     rng = np.random.default_rng(config.seed)
     if timer is None:
@@ -288,7 +293,12 @@ def train_ranker(
         fm_train = assembler.assemble(train_w)
     grid = None
     with timer.section("lr_fit"):
-        lr = LogisticRegression(max_iter=config.lr_max_iter, reg_param=config.lr_reg_param)
+        lr = LogisticRegression(
+            max_iter=config.lr_max_iter, reg_param=config.lr_reg_param,
+            # CV-grid mode shards the GRID axis (grid_mesh); a row-sharded
+            # batch on top of that is unsupported by fit_many.
+            mesh=None if weight_cols else lr_mesh,
+        )
         labels = train_w["starring"].to_numpy(np.float32)
         if not weight_cols:
             lr_model = lr.fit(

@@ -63,15 +63,16 @@ def main(argv: list[str] | None = None) -> int:
         import jax
 
         jax.config.update("jax_platforms", args.platform)
-    # After parsing (so --help / usage errors never pay the jax import):
-    # persistent executable cache — repeat job submissions skip XLA compile.
-    from albedo_tpu.utils.compilation_cache import enable_persistent_compilation_cache
-
-    enable_persistent_compilation_cache()
     args._rest = _rest  # job-specific flags (e.g. collect_data --db/--token)
     if args.job not in _JOBS:
         print(f"no such job: {args.job}", file=sys.stderr)
         return 2
+    # After arg validation: persistent executable cache, so repeat job
+    # submissions skip XLA compile. Env-var-based when jax isn't imported
+    # yet — host-only jobs never pay the jax import for this.
+    from albedo_tpu.utils.compilation_cache import enable_persistent_compilation_cache
+
+    enable_persistent_compilation_cache()
     # Join the multi-host world (launcher env-configured; single-process runs
     # are a no-op) BEFORE any job touches jax.devices()/make_mesh, so meshes
     # span every host's devices (parallel/mesh.py init_distributed).

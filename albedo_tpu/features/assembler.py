@@ -100,6 +100,13 @@ class FeatureMatrix:
         """Width of the LOGICAL dense block: scalars + factored vec columns."""
         return int(self.dense.shape[1]) + sum(int(v.shape[1]) for v in self.vec.values())
 
+    def vec_fields(self) -> list[str]:
+        """Vec field names in the CANONICAL (sorted) order — the order of
+        their slices within the logical dense block. Sorted because jax
+        reconstructs dict pytrees in sorted-key order inside jit, so offset
+        pairing must not depend on insertion order."""
+        return sorted(self.vec)
+
     @property
     def num_features(self) -> int:
         """Width of the equivalent flat one-hot feature vector."""
@@ -115,7 +122,8 @@ class FeatureMatrix:
         if not self.vec:
             return self.dense
         return np.concatenate(
-            [self.dense] + [self.vec[f][self.vec_rep[f]] for f in self.vec], axis=1
+            [self.dense] + [self.vec[f][self.vec_rep[f]] for f in self.vec_fields()],
+            axis=1,
         )
 
     def select(self, rows: np.ndarray) -> "FeatureMatrix":
@@ -222,7 +230,12 @@ class FeatureAssemblerModel(Transformer):
             )
             names.append(c)
         vec, vec_rep = {}, {}
-        for c in self.vector_cols:
+        # CANONICAL vec-field order is sorted(name): jax flattens dict
+        # pytrees in sorted-key order, so everything that pairs per-field
+        # slices of the flat dense coefficient vector (block_logits offsets,
+        # scales, center, dense_names) must agree on sorted order — insertion
+        # order is unrecoverable inside jit.
+        for c in sorted(self.vector_cols):
             self.require_cols(df, [c])
             if n:
                 rep, (uniq,) = _dedup_rows(col_values(df[c]))

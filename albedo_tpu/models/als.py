@@ -71,7 +71,8 @@ class ALSModel:
         ui = np.asarray(user_indices)
         n = self._uf_raw.shape[0]
         if ui.size and (int(ui.min()) < 0 or int(ui.max()) >= n):
-            # Match numpy fancy-indexing semantics on the device path too —
+            # Out-of-range indices (including negatives — dense user indices
+            # have no wrap-around meaning here) are rejected on BOTH paths:
             # jnp.take's default clipping would silently score a wrong user.
             raise IndexError(f"user index out of range [0, {n}): {ui.min()}..{ui.max()}")
         if isinstance(self._uf_raw, jax.Array):

@@ -51,7 +51,7 @@ def feature_batch(fm: FeatureMatrix) -> dict[str, jnp.ndarray]:
     a row-shardable rectangle — and ``block_logits`` consumes either.
     """
     batch: dict[str, jnp.ndarray] = {"dense": jnp.asarray(fm.dense)}
-    for f in fm.vec:
+    for f in fm.vec_fields():  # canonical sorted order (see vec_fields)
         rep, order, indptr = _rep_layout(fm.vec_rep[f], fm.vec[f].shape[0])
         batch[f"vecflat:{f}:vec"] = jnp.asarray(fm.vec[f])
         batch[f"vecflat:{f}:rep"] = jnp.asarray(rep)
@@ -139,7 +139,7 @@ def inverse_std_scales(fm: FeatureMatrix) -> Params:
     # Scalar block: f64 ACCUMULATION without materializing an f64 copy (the
     # astype copied 1.3 GB at r5 ranker bench scale).
     std_parts = [fm.dense.std(axis=0, dtype=np.float64, ddof=ddof)]
-    for f in fm.vec:
+    for f in fm.vec_fields():  # canonical order must match block_logits offsets
         # Factored vec field: moments of the EXPANDED column are count-
         # weighted moments over the distinct vectors — O(U*D), not O(N*D).
         v = fm.vec[f].astype(np.float64)
@@ -207,7 +207,7 @@ def dense_center(fm: FeatureMatrix) -> np.ndarray:
     """
     n = max(1, fm.n_rows)
     parts = [fm.dense.mean(axis=0, dtype=np.float64)]
-    for f in fm.vec:
+    for f in fm.vec_fields():  # canonical order must match block_logits offsets
         counts = np.bincount(fm.vec_rep[f], minlength=fm.vec[f].shape[0])
         parts.append(counts.astype(np.float64) @ fm.vec[f].astype(np.float64) / n)
     out = np.concatenate(parts) if len(parts) > 1 else parts[0]
@@ -303,23 +303,32 @@ def block_logits(
     dense = batch["dense"] if center is None else batch["dense"] - center[:d_scalar]
     logits = params["bias"] + dense @ w_dense[:d_scalar]
     off = d_scalar
-    for key, arr in batch.items():
-        if key.startswith("vecflat:") and key.endswith(":vec"):
-            f = key[len("vecflat:"):-len(":vec")]
-            d = arr.shape[1]
-            w_f = w_dense[off:off + d]
-            # Center BEFORE the contraction: ``vec @ w - c @ w`` cancels two
-            # large near-equal dots per distinct vector (w2v dims are
-            # near-constant — the exact conditioning problem dense_center
-            # exists for; computing it the cancelling way sent the r5 bench
-            # fit from 31 to 163 L-BFGS iterations).
-            vals = arr if center is None else arr - center[off:off + d]
-            lu = vals @ w_f
-            p = f"vecflat:{f}:"
-            logits = logits + _rep_term(
-                lu, batch[p + "rep"], batch[p + "order"], batch[p + "indptr"]
-            )
-            off += d
+    # EXPLICIT sorted field order: scales/center/dense_names are laid out in
+    # sorted(vec) order (FeatureMatrix.vec_fields) and jax reconstructs dict
+    # pytrees sorted-by-key inside jit anyway — an insertion-order iteration
+    # here would silently pair one field's values with another's moments and
+    # coefficient slice whenever vector_cols aren't alphabetical.
+    vec_fields = sorted(
+        key[len("vecflat:"):-len(":vec")]
+        for key in batch
+        if key.startswith("vecflat:") and key.endswith(":vec")
+    )
+    for f in vec_fields:
+        arr = batch[f"vecflat:{f}:vec"]
+        d = arr.shape[1]
+        w_f = w_dense[off:off + d]
+        # Center BEFORE the contraction: ``vec @ w - c @ w`` cancels two
+        # large near-equal dots per distinct vector (w2v dims are
+        # near-constant — the exact conditioning problem dense_center
+        # exists for; computing it the cancelling way sent the r5 bench
+        # fit from 31 to 163 L-BFGS iterations).
+        vals = arr if center is None else arr - center[off:off + d]
+        lu = vals @ w_f
+        p = f"vecflat:{f}:"
+        logits = logits + _rep_term(
+            lu, batch[p + "rep"], batch[p + "order"], batch[p + "indptr"]
+        )
+        off += d
     for key, arr in batch.items():
         if key.startswith("cat:"):
             f = key[len("cat:"):]

@@ -32,15 +32,28 @@ def enable_persistent_compilation_cache(cache_dir: str | Path | None = None) -> 
     global _ENABLED
     if os.environ.get("ALBEDO_JAX_CACHE", "1") == "0":
         return False
-    import jax
-
-    if _ENABLED or jax.config.jax_compilation_cache_dir:
-        _ENABLED = True
+    if _ENABLED:
         return True
     if cache_dir is None:
         from albedo_tpu.settings import get_settings
 
         cache_dir = get_settings().data_dir / "jax-cache"
+    import sys
+
+    if "jax" not in sys.modules:
+        # jax not imported yet (e.g. a host-only CLI job that may never touch
+        # it): configure via env vars, which jax reads at import — the call
+        # stays free of the multi-second jax import.
+        Path(cache_dir).mkdir(parents=True, exist_ok=True)
+        os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", str(cache_dir))
+        os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "2")
+        _ENABLED = True
+        return True
+    import jax
+
+    if jax.config.jax_compilation_cache_dir:
+        _ENABLED = True
+        return True
     Path(cache_dir).mkdir(parents=True, exist_ok=True)
     jax.config.update("jax_compilation_cache_dir", str(cache_dir))
     # Executables this small recompile faster than they deserialize; only

@@ -786,6 +786,12 @@ def main() -> None:
                 user_actual_items(test, k=30),
             )
             crosscheck = {
+                # The `implicit`-package external anchor remains unavailable:
+                # r5 install attempt failed (zero egress — pypi.org does not
+                # resolve; no vendorable wheel in the image). The dense numpy
+                # reference + recall curve (tests/test_als_anchor.py) and the
+                # residual checks below are the independent anchors.
+                "implicit_package": "unavailable (zero-egress; r5 install attempt recorded)",
                 "cholesky_ndcg30": round(float(ndcg_exact), 5),
                 "cholesky_train_s": round(exact_train_s, 3),
                 "cholesky_fit_breakdown": dict(exact_als.last_fit_report),

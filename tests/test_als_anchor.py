@@ -1,6 +1,10 @@
 """External-anchor validation of the implicit-ALS trainer.
 
-The `implicit` package is not installed in this image, so the anchor is the
+The `implicit` package is not installed in this image and cannot be: an
+install was attempted and recorded in r5 — ``pip install implicit`` fails
+with ``NameResolutionError: Failed to resolve 'pypi.org'`` (the environment
+has zero network egress), and no wheel/sdist is vendored in the image to
+build from. The anchor is therefore the
 EXACT dense-solve reference: an independent numpy implementation of the
 Hu-Koren-Volinsky normal equations with Spark MLlib's conventions
 (c = 1 + alpha*r, regParam scaled by the row's rating count, item-then-user

@@ -407,3 +407,41 @@ def test_factored_bag_fit_matches_per_row(rng):
     np.testing.assert_allclose(
         m_f.predict_proba(factored), m_p.predict_proba(per_row), atol=1e-3
     )
+
+
+def test_vec_field_order_is_canonical(rng):
+    """Vec-field slices of the flat dense coefficient vector must pair
+    correctly even when field names are NOT alphabetical in insertion order
+    (jax reconstructs dict pytrees sorted-by-key inside jit — r5 review
+    finding). Different dims per field make any misalignment loud."""
+    n = 300
+    vec_z = rng.normal(size=(7, 3)).astype(np.float32)   # name sorts LAST
+    vec_a = rng.normal(size=(11, 6)).astype(np.float32)  # name sorts FIRST
+    rep_z = rng.integers(0, 7, n).astype(np.int32)
+    rep_a = rng.integers(0, 11, n).astype(np.int32)
+    scalars = rng.normal(size=(n, 2)).astype(np.float32)
+    y = (scalars[:, 0] + vec_a[rep_a][:, 0] > 0).astype(np.float32)
+
+    # Insertion order z-then-a (non-alphabetical) must behave identically to
+    # the expanded layout, whose column order follows vec_fields() (sorted).
+    factored = FeatureMatrix(
+        dense=scalars,
+        dense_names=["s0", "s1"]
+        + [f"a[{i}]" for i in range(6)] + [f"z[{i}]" for i in range(3)],
+        cat={}, cat_sizes={}, bag_idx={}, bag_val={}, bag_sizes={},
+        vec={"z": vec_z, "a": vec_a}, vec_rep={"z": rep_z, "a": rep_a},
+    )
+    assert factored.vec_fields() == ["a", "z"]
+    expanded = FeatureMatrix(
+        dense=factored.expanded_dense(), dense_names=factored.dense_names,
+        cat={}, cat_sizes={}, bag_idx={}, bag_val={}, bag_sizes={},
+    )
+    m_f = LogisticRegression(max_iter=60).fit(factored, y)
+    m_e = LogisticRegression(max_iter=60).fit(expanded, y)
+    assert abs(m_f.train_loss - m_e.train_loss) < 1e-4, (m_f.train_loss, m_e.train_loss)
+    np.testing.assert_allclose(
+        m_f.predict_proba(factored), m_e.predict_proba(expanded), atol=1e-3
+    )
+    np.testing.assert_allclose(
+        m_f.coefficients["dense"], m_e.coefficients["dense"], atol=5e-3
+    )
