@@ -218,10 +218,16 @@ def _segment_sums(data: jnp.ndarray, indptr: jnp.ndarray) -> jnp.ndarray:
     """Sorted-segment sums via the cumsum-difference trick: an exclusive
     cumsum gathered at segment boundaries. No scatter — TPU scatters and
     large random gathers both measured ~100x slower than this streaming
-    formulation for the bag blocks. float32 cumsum over ~10^7 mixed-sign
-    entries costs ~eps * |running total| per segment (~1e-4 absolute on
-    bench-scale logits) — well inside LR tolerance; gradient parity vs the
-    padded path is test-pinned."""
+    formulation for the bag blocks.
+
+    Precision (ADVICE r4 #3): a float32 cumsum costs ~eps * |running prefix|
+    per segment. Since r5 the streams are SHORT — factored bags collapse the
+    flat entries to the distinct-document set (~270k vs 17M at ranker bench
+    scale) and the _rep_term backward runs over one grad value per data row
+    (~382k, entries ~1/N each, prefix O(1)) — so the absolute error stays
+    ~1e-6..1e-5, far inside LR tolerance. Guarded by a bench-scale f64-parity
+    test (tests/test_models.py::test_segment_sums_precision_at_scale) rather
+    than an f64 cumsum, which would need global jax_enable_x64."""
     c = jnp.concatenate([jnp.zeros(1, data.dtype), jnp.cumsum(data)])
     return c[indptr[1:]] - c[indptr[:-1]]
 

@@ -11,10 +11,18 @@ import dataclasses
 from typing import Any
 
 
+# Infrastructure fields elided from dumps: runtime wiring, not
+# hyperparameters. Meaningful None HYPERparameters (e.g. ImplicitALS
+# max_len=None, gather_dtype=None) print like Spark's explainParams prints
+# defaults — two configs differing only in a None-vs-set field must not dump
+# identically (ADVICE r4 #4).
+_INFRA_FIELDS = frozenset({"mesh", "init_factors", "callback"})
+
+
 def explain_params(estimator: Any) -> str:
     """``name: field=value, ...`` over dataclass fields (non-dataclasses fall
-    back to their public ``__dict__``), skipping unset/None infrastructure
-    fields like ``mesh``."""
+    back to their public ``__dict__``), eliding only the explicit
+    infrastructure fields (``_INFRA_FIELDS``)."""
     name = type(estimator).__name__
     if dataclasses.is_dataclass(estimator):
         pairs = [
@@ -25,5 +33,5 @@ def explain_params(estimator: Any) -> str:
         pairs = [
             (k, v) for k, v in vars(estimator).items() if not k.startswith("_")
         ]
-    body = ", ".join(f"{k}={v!r}" for k, v in pairs if v is not None)
+    body = ", ".join(f"{k}={v!r}" for k, v in pairs if k not in _INFRA_FIELDS)
     return f"{name}({body})"

@@ -32,6 +32,13 @@ additional JSON lines precede it: an early copy of the flagship record
 (emitted before the ranker runs, so a ranker hang cannot discard it) and the
 "ranker_train_wallclock" record. On failure the single line carries
 "error"/"stage" and rc != 0.
+
+PARTIAL-SUCCESS CONTRACT (ADVICE r4 #1): if the ranker stage wedges after a
+good ALS headline, the watchdog re-emits the flagship record as the last line
+with "status": "partial" and the failure in "ranker_error", and exits 0 so
+the headline survives exit-code-only consumers. Consumers that care about
+the ranker MUST check `ranker_error is null` (equivalently `status ==
+"complete"`), not just the exit code.
 """
 
 from __future__ import annotations
@@ -170,6 +177,7 @@ def start_watchdog() -> None:
         if flagship is not None:
             record = dict(flagship)
             record["ranker_error"] = f"watchdog: bench exceeded {RUN_TIMEOUT_S}s"
+            record["status"] = "partial"  # see PARTIAL-SUCCESS CONTRACT
             print(json.dumps(record), flush=True)
             os._exit(0)  # headline survived; only the ranker stage was lost
         record = error_record(
@@ -830,6 +838,7 @@ def main() -> None:
     if FLAGSHIP_RECORD is not None:
         final = dict(FLAGSHIP_RECORD)
         final["ranker_error"] = ranker_error
+        final["status"] = "complete" if ranker_error is None else "partial"
     else:
         final = als_record(train_s, ndcg, info, flop, mfu, peak_source,
                            gemm_f32, gemm_bf16, hbm_gbps, dispatch_s, phases,
