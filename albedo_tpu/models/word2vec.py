@@ -136,6 +136,14 @@ class Word2Vec:
     min_count: int = 10
     max_iter: int = 30
     negatives: int = 5
+    # 0 = per-pair negatives (textbook SGNS; the parity-tested default).
+    # K > 0 = ONE shared pool of K noise words per step: the negative term
+    # becomes a (B, d) x (d, K) MXU GEMM instead of a (B, neg, d) gather —
+    # the gather streamed ~315 MB/step at bs=65536 and dominated the fit —
+    # with the negative loss scaled by negatives/K so the expected gradient
+    # magnitude matches the per-pair objective. Standard large-batch
+    # word2vec practice; quality is test-gated like the default path.
+    shared_negatives: int = 0
     batch_size: int = 4096
     learning_rate: float = 0.025
     subsample: float = 1e-3  # frequent-word subsampling threshold (0 = off)
@@ -201,8 +209,14 @@ class Word2Vec:
         if centers.size == 0:
             return Word2VecModel(vocab, np.zeros((v_size, self.dim), np.float32), self.input_col, self.output_col or f"{self.input_col}__w2v")
 
-        # Negative-sampling distribution: unigram^0.75 (word2vec standard).
-        noise_logits = jnp.asarray(0.75 * np.log(freq), dtype=jnp.float32)
+        # Negative-sampling distribution: unigram^0.75 (word2vec standard),
+        # sampled by inverse CDF (searchsorted over the cumulative table,
+        # O(B*neg*log V)). jax.random.categorical would materialize a
+        # (B, neg, V) gumbel tensor per step — ~20 GB/step at refscale
+        # (bs=65536, V=15k), the r5 scale-up OOM.
+        p_noise = freq**0.75
+        p_noise /= p_noise.sum()
+        noise_cdf = jnp.asarray(np.cumsum(p_noise), dtype=jnp.float32)
 
         n_pairs = centers.shape[0]
         # bs is NOT rounded for the mesh: the sharded fit must run the exact
@@ -221,10 +235,26 @@ class Word2Vec:
         opt_state = opt.init(params)
 
         neg = self.negatives
+        shared = self.shared_negatives
 
         def loss_fn(p, c_idx, o_idx, neg_idx):
-            # (B, d) center vectors; (B, 1+neg, d) context rows (true + noise).
             vc = p["in"][c_idx]
+            if shared:
+                # neg_idx: (K,) shared pool. Positive term per pair; negative
+                # term = dense (B, K) logits GEMM, scaled to the per-pair
+                # objective's expected magnitude.
+                vo_pos = p["out"][o_idx]
+                pos_logit = jnp.sum(vc * vo_pos, axis=1)
+                vneg = p["out"][neg_idx]
+                neg_logits = vc @ vneg.T
+                pos_loss = optax.sigmoid_binary_cross_entropy(
+                    pos_logit, jnp.ones_like(pos_logit)
+                )
+                neg_loss = optax.sigmoid_binary_cross_entropy(
+                    neg_logits, jnp.zeros_like(neg_logits)
+                ).sum(axis=1) * (neg / shared)
+                return (pos_loss + neg_loss).mean()
+            # (B, d) center vectors; (B, 1+neg, d) context rows (true + noise).
             rows = jnp.concatenate([o_idx[:, None], neg_idx], axis=1)
             vo = p["out"][rows]
             logits = jnp.einsum("bd,bkd->bk", vc, vo)
@@ -258,7 +288,10 @@ class Word2Vec:
                 p, s, k = carry
                 c_idx, o_idx = batch
                 k, k_neg = jax.random.split(k)
-                neg_idx = jax.random.categorical(k_neg, noise_logits, shape=(bs, neg))
+                neg_shape = (shared,) if shared else (bs, neg)
+                u = jax.random.uniform(k_neg, neg_shape, jnp.float32)
+                neg_idx = jnp.searchsorted(noise_cdf, u).astype(jnp.int32)
+                neg_idx = jnp.minimum(neg_idx, noise_cdf.shape[0] - 1)
                 loss, grads = jax.value_and_grad(loss_fn)(p, c_idx, o_idx, neg_idx)
                 updates, s = opt.update(grads, s, p)
                 return (optax.apply_updates(p, updates), s, k), loss

@@ -466,3 +466,25 @@ def test_segment_sums_precision_at_scale():
     exact[np.diff(indptr) == 0] = 0.0
     err = np.abs(got - exact)
     assert float(err.max()) < 1e-6, float(err.max())
+
+
+def test_w2v_shared_negatives_clusters(w2v_clusters):
+    """The shared-negative-pool fast path (one noise pool per step, MXU GEMM
+    negative term) must learn the same cluster structure as per-pair SGNS."""
+    rng = np.random.default_rng(0)
+    a = ["apple", "banana", "cherry", "grape"]
+    b = ["python", "jax", "compiler", "kernel"]
+    sentences = []
+    for _ in range(500):
+        pool = a if rng.random() < 0.5 else b
+        sentences.append([pool[i] for i in rng.integers(0, 4, size=6)])
+    model = Word2Vec(
+        dim=16, window=3, min_count=1, max_iter=25, batch_size=512,
+        subsample=0.0, seed=1, shared_negatives=32,
+    ).fit_corpus(sentences)
+    v = model.vectors / (np.linalg.norm(model.vectors, axis=1, keepdims=True) + 1e-9)
+    idx = {w: i for i, w in enumerate(model.vocab)}
+    within = np.mean([v[idx[x]] @ v[idx[y]] for x in a for y in a if x != y])
+    across = np.mean([v[idx[x]] @ v[idx[y]] for x in a for y in b])
+    assert within > 0.8, within
+    assert across < 0.5, across
