@@ -13,7 +13,8 @@ reference's NDCG is reproducible — SURVEY.md section 7 hard part (b)):
 The reference executes this inside Spark MLlib as shuffled user/item blocks
 with per-block LAPACK Cholesky on executors. Here each half-sweep is a set of
 fixed-shape bucket solves: gather ``Y[idx] -> (B, L, k)``, one fused einsum for
-the Gramian correction, batched solve, scatter back — all on the MXU, no
+the Gramian correction, batched solve, land solved rows by an
+inverse-permutation gather — all on the MXU, no
 shuffle. Buckets come from ``albedo_tpu.datasets.bucket_rows``. The layout is
 the same family as ALX's TPU matrix factorization (arXiv:2112.02194 — padded
 dense gather blocks over sharded factor tables), and the warm-started-CG fast

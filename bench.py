@@ -21,8 +21,9 @@ and reports a solver-aware analytic FLOP model against the chip's published
 bf16 peak, a measured chained-GEMM rate, AND a measured HBM streaming rate
 with a bytes-per-iteration model — the sweep is bandwidth-bound, so
 vs_bandwidth_roofline is the honest utilization figure. A per-phase
-breakdown (gather / solve / scatter) and the measured per-dispatch latency
-round out the record.
+breakdown (gather / solve / landing), the fit/cold-prep wall-clock split,
+the per-run exact-solver cross-check with float64 normal-equation residuals,
+and the measured per-dispatch latency round out the record.
 
 Output contract: the LAST line printed is the flagship JSON record
 {"metric": "als_train_wallclock_rank50_iter26", "value", "unit",

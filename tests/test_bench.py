@@ -92,3 +92,23 @@ def test_watchdog_preserves_flagship_record():
     assert record["metric"] == "als_train_wallclock_rank50_iter26"
     assert record["value"] is not None and record["value"] > 0
     assert "watchdog" in (record["ranker_error"] or "")
+    assert record["status"] == "partial"  # the documented partial contract
+
+
+def test_w2v_refscale_record_shape(monkeypatch):
+    """Tiny-scale run of the reference-scale W2V bench: the record must state
+    corpus volume and throughput so the multiplier is priced per token."""
+    monkeypatch.setenv("ALBEDO_BENCH_W2V_TOKENS", "20000")
+    monkeypatch.setenv("ALBEDO_BENCH_W2V_VOCAB", "500")
+    rec = bench.w2v_refscale_bench()
+    assert rec["metric"] == "w2v_train_wallclock_refscale"
+    assert rec["corpus_tokens"] == 20000
+    assert rec["value"] > 0 and rec["epoch_tokens_per_s"] > 0
+    assert rec["vocab_size"] > 0
+    assert "scale_note" in rec and "unpublished" in rec["scale_note"]
+
+
+def test_watchdog_partial_status_field():
+    """The watchdog re-emit carries status=partial (ADVICE r4 #1 contract)."""
+    record = bench.error_record("x", "y")
+    assert "status" not in record  # hard failures carry stage/error instead
