@@ -19,13 +19,25 @@
 FROM python:3.12-slim
 
 ARG JAX_EXTRA=cpu
+# Optional-dependency extras baked into the image (comma-separated names from
+# [project.optional-dependencies]): mysql makes the compose `ingest` profile's
+# mysql:// table source work from the app container; checkpoint enables the
+# Orbax-backed resumable ALS fit.
+ARG PIP_EXTRAS=mysql,checkpoint
 
 WORKDIR /app
 
-# Dependency layer first (stable across source edits).
+# Dependency layer first (stable across source edits), RESOLVED FROM
+# pyproject.toml — a hard-coded pip list here silently drifts the moment the
+# project gains a dependency (ADVICE r5 #2). pytest rides along for
+# `docker run ... make test`.
 COPY pyproject.toml ./
-RUN pip install --no-cache-dir "jax[${JAX_EXTRA}]" numpy pandas optax chex \
-    orbax-checkpoint pytest
+RUN python -c "import os, tomllib; \
+proj = tomllib.load(open('pyproject.toml', 'rb'))['project']; \
+extras = [e for e in os.environ.get('PIP_EXTRAS', '').split(',') if e]; \
+deps = proj['dependencies'] + [d for e in extras for d in proj['optional-dependencies'][e]]; \
+open('/tmp/requirements.txt', 'w').write('\n'.join(deps) + '\n')" \
+ && pip install --no-cache-dir "jax[${JAX_EXTRA}]" pytest -r /tmp/requirements.txt
 
 COPY albedo_tpu ./albedo_tpu
 COPY tests ./tests

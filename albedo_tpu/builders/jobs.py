@@ -64,6 +64,16 @@ class JobContext:
         self._cache: dict[str, object] = {}
         if tables is not None:
             self._cache["tables"] = tables
+        # Persistent executable reuse by default, even when a JobContext is
+        # built directly (bench, notebooks) rather than through cli.main —
+        # idempotent, and a no-op under --no-compilation-cache /
+        # ALBEDO_JAX_CACHE=0.
+        if not bool(getattr(args, "no_compilation_cache", False)):
+            from albedo_tpu.utils.compilation_cache import (
+                enable_persistent_compilation_cache,
+            )
+
+            enable_persistent_compilation_cache()
 
     def artifact_name(self, base: str) -> str:
         return f"{self.tag}-{base}"

@@ -316,13 +316,19 @@ def train_ranker(
     # r4 stage conflated them and read as 63% of the ranker wall-clock):
     # lr_prepare = host batch layout + standardization moments + upload
     # dispatch; lr_compile = one-time XLA compile (0 on a warm executable
-    # cache); lr_fit = the device L-BFGS solve.
+    # cache); lr_fit = the device L-BFGS solve. In grid mode the split comes
+    # from grid_models[0], and prepare/compile are SHARED by the whole
+    # vmapped solve — they are not per-model costs.
     for part, name in ((first_model.prep_s, "lr_prepare"),
                        (first_model.compile_s, "lr_compile")):
         if part is not None:
             timer.totals["lr_fit"] -= part
             timer.totals[name] = timer.totals.get(name, 0.0) + part
             timer.counts[name] = timer.counts.get(name, 0) + 1
+    # The parts were measured by perf_counter scopes inside fit() while the
+    # stage total came from the timer's own clock scope: tiny overlaps can
+    # drive the residual slightly negative — clamp at 0 (ADVICE r5 #4).
+    timer.totals["lr_fit"] = max(0.0, timer.totals["lr_fit"])
 
     # 6a. AUC on the held-out split (:354-364).
     with timer.section("auc_eval"):

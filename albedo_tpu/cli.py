@@ -47,6 +47,14 @@ def main(argv: list[str] | None = None) -> int:
         "--cg-steps", type=int, default=3, help="CG steps per half-sweep (--solver cg)"
     )
     parser.add_argument(
+        "--no-compilation-cache",
+        action="store_true",
+        help="disable the persistent XLA executable cache (on by default; "
+        "directory = $ALBEDO_DATA_DIR/jax-cache, overridable via "
+        "JAX_COMPILATION_CACHE_DIR; ALBEDO_JAX_CACHE=0 is the env "
+        "equivalent of this flag)",
+    )
+    parser.add_argument(
         "--platform",
         default=None,
         help="force a jax platform (e.g. 'cpu') — the laptop-mode switch "
@@ -69,10 +77,12 @@ def main(argv: list[str] | None = None) -> int:
         return 2
     # After arg validation: persistent executable cache, so repeat job
     # submissions skip XLA compile. Env-var-based when jax isn't imported
-    # yet — host-only jobs never pay the jax import for this.
-    from albedo_tpu.utils.compilation_cache import enable_persistent_compilation_cache
+    # yet — host-only jobs never pay the jax import for this. Opt out with
+    # --no-compilation-cache (or ALBEDO_JAX_CACHE=0).
+    if not args.no_compilation_cache:
+        from albedo_tpu.utils.compilation_cache import enable_persistent_compilation_cache
 
-    enable_persistent_compilation_cache()
+        enable_persistent_compilation_cache()
     # Join the multi-host world (launcher env-configured; single-process runs
     # are a no-op) BEFORE any job touches jax.devices()/make_mesh, so meshes
     # span every host's devices (parallel/mesh.py init_distributed).

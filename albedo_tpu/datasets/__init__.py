@@ -6,7 +6,7 @@ feeding happens in ``albedo_tpu.ops``.
 """
 
 from albedo_tpu.datasets.artifacts import load_or_create, load_or_create_df, load_or_create_npz
-from albedo_tpu.datasets.ragged import Bucket, bucket_rows
+from albedo_tpu.datasets.ragged import Bucket, bucket_rows, grouped_bucket_rows
 from albedo_tpu.datasets.split import random_split_by_user, sample_test_users
 from albedo_tpu.datasets.star_matrix import StarMatrix, clean_by_counts
 from albedo_tpu.datasets.synthetic import synthetic_stars
@@ -24,6 +24,7 @@ __all__ = [
     "StarMatrix",
     "clean_by_counts",
     "bucket_rows",
+    "grouped_bucket_rows",
     "load_or_create",
     "load_or_create_df",
     "load_or_create_npz",

@@ -15,6 +15,8 @@ user/item blocks, and for ``ALSRecommender.blockify`` (4096-row blocks,
 from __future__ import annotations
 
 import dataclasses
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable
 
 import numpy as np
 
@@ -71,28 +73,37 @@ def _pad_len(n: int, multiple: int) -> int:
     return t
 
 
-def bucket_rows(
+@dataclasses.dataclass(frozen=True)
+class BucketPlan:
+    """One bucket's layout, decided before any array is filled.
+
+    Splitting planning (a cheap sequential scan over the length-sorted rows)
+    from filling (per-bucket NumPy scatters that release the GIL) is what lets
+    the cold-path pipeline fill buckets on a thread pool and upload finished
+    shape groups while later ones are still being packed — the plan fixes the
+    exact same chunk boundaries and tier shapes the sequential path produces,
+    so the filled buckets are byte-identical however they are scheduled.
+    """
+
+    rows: np.ndarray         # (n_take,) dense row ids, length-sorted chunk order
+    shape: tuple[int, int]   # (B, L) allocated slot/length tiers
+    cap: int                 # per-row entry cap (pad length or max_len)
+
+
+def plan_buckets(
     indptr: np.ndarray,
-    indices: np.ndarray,
-    vals: np.ndarray,
     batch_size: int = 1024,
     len_multiple: int = 8,
     max_len: int | None = None,
     max_entries: int | None = None,
-) -> list[Bucket]:
-    """Chunk CSR rows into fixed-shape padded batches.
+) -> list[BucketPlan]:
+    """Chunk CSR rows into fixed-shape bucket layouts (no fills yet).
 
     Rows are sorted by nonzero count so batch-mates have similar lengths; each
-    batch is padded to a power-of-two length (bounded padding waste, bounded
-    compile count). Rows longer than ``max_len`` are truncated to their most
-    recent ``max_len`` entries, mirroring the reference's
-    ``maxStarredReposCount`` cap (``LogisticRegressionRanker.scala:133``).
-
-    ``max_entries`` bounds ``B * L`` per bucket so the downstream
-    ``(B, L, rank)`` factor gather fits in device memory: long-row buckets get
-    proportionally (power-of-two) smaller batch sizes.
-
-    Empty rows are skipped: ALS leaves those factors at their current value,
+    batch is padded to a power-of-two-ish length (bounded padding waste,
+    bounded compile count). ``max_entries`` bounds ``B * L`` per bucket so the
+    downstream ``(B, L, rank)`` factor gather fits in device memory. Empty
+    rows are skipped: ALS leaves those factors at their current value,
     matching cold-start behavior.
     """
     lengths = np.diff(indptr)
@@ -111,7 +122,7 @@ def bucket_rows(
             pad_l = max(pad_l, n)
         return pad_l
 
-    buckets: list[Bucket] = []
+    plans: list[BucketPlan] = []
     start = 0
     n_rows = order.shape[0]
     while start < n_rows:
@@ -126,7 +137,6 @@ def bucket_rows(
         end = start
         while end < n_rows and end - start < allowed and eff[end] <= pad_l:
             end += 1
-        chunk = order[start:end]
         n_take = end - start
         # Slot-count tiers: powers of two up to 1024, then 1024-multiples.
         # Pure pow-2 rounding wastes up to 2x SOLVE slots per bucket once
@@ -139,27 +149,157 @@ def bucket_rows(
         # Never exceed the caller's slot budget (or entry budget): tier
         # rounding quantizes shapes but must not grow the bucket past them.
         b = max(n_take, min(b, allowed))
-        start = end
-
-        idx = np.zeros((b, pad_l), dtype=np.int32)
-        val = np.zeros((b, pad_l), dtype=np.float32)
-        mask = np.zeros((b, pad_l), dtype=bool)
-        row_ids = np.full((b,), -1, dtype=np.int32)
-
         cap = pad_l if max_len is None else min(pad_l, max_len)
-        # Vectorized slot fill (one scatter per bucket, no per-row Python):
-        # rows over cap keep their TAIL = most recent entries in insert order.
-        hi = indptr[chunk + 1].astype(np.int64)
-        take = np.minimum(hi - indptr[chunk].astype(np.int64), cap)
-        pos = segment_positions(take)
-        slot_of = np.repeat(np.arange(n_take), take)
-        flat = np.repeat(hi - take, take) + pos
-        row_ids[:n_take] = chunk
-        idx[slot_of, pos] = indices[flat]
-        val[slot_of, pos] = vals[flat]
-        mask[slot_of, pos] = True
-        buckets.append(Bucket(row_ids=row_ids, idx=idx, val=val, mask=mask))
-    return buckets
+        plans.append(BucketPlan(rows=order[start:end], shape=(b, pad_l), cap=cap))
+        start = end
+    return plans
+
+
+def fill_bucket(
+    plan: BucketPlan,
+    indptr: np.ndarray,
+    indices: np.ndarray,
+    vals: np.ndarray,
+    out: Bucket | None = None,
+) -> Bucket:
+    """Execute one plan's scatter fill. ``out`` (zero-initialized arrays,
+    ``row_ids`` pre-filled with -1 — possibly views into a preallocated group
+    slab) lets the grouped builder fill stacked arrays in place, skipping the
+    ``np.stack`` copy the group step used to pay."""
+    b, pad_l = plan.shape
+    if out is None:
+        out = Bucket(
+            row_ids=np.full((b,), -1, dtype=np.int32),
+            idx=np.zeros((b, pad_l), dtype=np.int32),
+            val=np.zeros((b, pad_l), dtype=np.float32),
+            mask=np.zeros((b, pad_l), dtype=bool),
+        )
+    chunk = plan.rows
+    n_take = chunk.shape[0]
+    # Vectorized slot fill (one scatter per bucket, no per-row Python):
+    # rows over cap keep their TAIL = most recent entries in insert order.
+    hi = indptr[chunk + 1].astype(np.int64)
+    take = np.minimum(hi - indptr[chunk].astype(np.int64), plan.cap)
+    pos = segment_positions(take)
+    slot_of = np.repeat(np.arange(n_take), take)
+    flat = np.repeat(hi - take, take) + pos
+    out.row_ids[:n_take] = chunk
+    out.idx[slot_of, pos] = indices[flat]
+    out.val[slot_of, pos] = vals[flat]
+    out.mask[slot_of, pos] = True
+    return out
+
+
+def bucket_rows(
+    indptr: np.ndarray,
+    indices: np.ndarray,
+    vals: np.ndarray,
+    batch_size: int = 1024,
+    len_multiple: int = 8,
+    max_len: int | None = None,
+    max_entries: int | None = None,
+    workers: int | None = None,
+) -> list[Bucket]:
+    """Chunk CSR rows into fixed-shape padded batches (plan + fill).
+
+    Rows longer than ``max_len`` are truncated to their most recent
+    ``max_len`` entries, mirroring the reference's ``maxStarredReposCount``
+    cap (``LogisticRegressionRanker.scala:133``).
+
+    With ``workers`` > 1 the per-bucket scatter fills run on a thread pool
+    (they are pure NumPy and release the GIL); the bucket list is returned in
+    plan order either way, so the output is byte-identical to the sequential
+    path — enforced by the parity test.
+    """
+    plans = plan_buckets(
+        indptr, batch_size=batch_size, len_multiple=len_multiple,
+        max_len=max_len, max_entries=max_entries,
+    )
+
+    def fill(p: BucketPlan) -> Bucket:
+        return fill_bucket(p, indptr, indices, vals)
+
+    if workers and workers > 1 and len(plans) > 1:
+        with ThreadPoolExecutor(max_workers=workers) as pool:
+            return list(pool.map(fill, plans))
+    return [fill(p) for p in plans]
+
+
+def grouped_bucket_rows(
+    indptr: np.ndarray,
+    indices: np.ndarray,
+    vals: np.ndarray,
+    batch_size: int = 1024,
+    len_multiple: int = 8,
+    max_len: int | None = None,
+    max_entries: int | None = None,
+    workers: int | None = None,
+    on_group: Callable[[int, Bucket], None] | None = None,
+) -> list[Bucket]:
+    """Plan, group by shape, and fill straight into the stacked group slabs.
+
+    Byte-identical to ``group_buckets(bucket_rows(...))`` (parity-tested) but
+    with one less full copy of the data: each bucket's scatter fill writes
+    directly into its ``(N, B, L)`` group slab slice instead of filling a
+    standalone bucket that ``np.stack`` then copies.
+
+    ``on_group(i, group)`` fires in shape-sorted group order as soon as group
+    ``i``'s fills complete — the hook the cold-path pipeline uses to start the
+    (async) host->device upload of a finished group while the thread pool is
+    still filling later ones.
+    """
+    plans = plan_buckets(
+        indptr, batch_size=batch_size, len_multiple=len_multiple,
+        max_len=max_len, max_entries=max_entries,
+    )
+    by_shape: dict[tuple[int, int], list[BucketPlan]] = {}
+    for p in plans:
+        by_shape.setdefault(p.shape, []).append(p)
+    ordered = sorted(by_shape.items())
+
+    groups: list[Bucket] = []
+    tasks: list[tuple[int, int, BucketPlan]] = []
+    for gi, ((b, pad_l), ps) in enumerate(ordered):
+        n = len(ps)
+        groups.append(
+            Bucket(
+                row_ids=np.full((n, b), -1, dtype=np.int32),
+                idx=np.zeros((n, b, pad_l), dtype=np.int32),
+                val=np.zeros((n, b, pad_l), dtype=np.float32),
+                mask=np.zeros((n, b, pad_l), dtype=bool),
+            )
+        )
+        tasks.extend((gi, si, p) for si, p in enumerate(ps))
+
+    def fill(task: tuple[int, int, BucketPlan]) -> None:
+        gi, si, p = task
+        g = groups[gi]
+        fill_bucket(
+            p, indptr, indices, vals,
+            out=Bucket(row_ids=g.row_ids[si], idx=g.idx[si], val=g.val[si], mask=g.mask[si]),
+        )
+
+    if workers and workers > 1 and len(tasks) > 1:
+        with ThreadPoolExecutor(max_workers=workers) as pool:
+            futures: dict[int, list] = {}
+            for task in tasks:
+                futures.setdefault(task[0], []).append(pool.submit(fill, task))
+            # Groups complete roughly in submission order; notifying in shape
+            # order lets the caller upload group 0 while group N still fills.
+            for gi in range(len(groups)):
+                for f in futures.get(gi, []):
+                    f.result()
+                if on_group is not None:
+                    on_group(gi, groups[gi])
+    else:
+        done = 0
+        for gi in range(len(groups)):
+            while done < len(tasks) and tasks[done][0] == gi:
+                fill(tasks[done])
+                done += 1
+            if on_group is not None:
+                on_group(gi, groups[gi])
+    return groups
 
 
 def padded_rows(
@@ -192,16 +332,27 @@ def group_buckets(buckets: list[Bucket]) -> list[Bucket]:
     one dispatch per bucket — the layout that lets the whole ALS fit compile
     into a single XLA program (``ops.als.als_fit_fused``), where the reference
     pays a Spark shuffle per block per sweep.
+
+    Stacked arrays are preallocated and filled slice-by-slice (no ``np.stack``
+    temporaries); ``grouped_bucket_rows`` goes one step further and scatters
+    fills directly into the slabs, never materializing per-bucket arrays.
     """
     by_shape: dict[tuple[int, int], list[Bucket]] = {}
     for b in buckets:
         by_shape.setdefault(b.shape, []).append(b)
+
+    def stack(arrays: list[np.ndarray]) -> np.ndarray:
+        out = np.empty((len(arrays),) + arrays[0].shape, dtype=arrays[0].dtype)
+        for i, a in enumerate(arrays):
+            out[i] = a
+        return out
+
     return [
         Bucket(
-            row_ids=np.stack([b.row_ids for b in bs]),
-            idx=np.stack([b.idx for b in bs]),
-            val=np.stack([b.val for b in bs]),
-            mask=np.stack([b.mask for b in bs]),
+            row_ids=stack([b.row_ids for b in bs]),
+            idx=stack([b.idx for b in bs]),
+            val=stack([b.val for b in bs]),
+            mask=stack([b.mask for b in bs]),
         )
         for _, bs in sorted(by_shape.items())
     ]

@@ -15,16 +15,27 @@ then user factors.
 from __future__ import annotations
 
 import dataclasses
+import os
+import time
+import weakref
+from concurrent.futures import ThreadPoolExecutor
 from typing import Any
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from albedo_tpu.datasets.ragged import Bucket, bucket_rows, device_bucket, group_buckets
+from albedo_tpu.datasets.ragged import (
+    Bucket,
+    bucket_rows,
+    device_bucket,
+    group_buckets,
+    grouped_bucket_rows,
+)
 from albedo_tpu.datasets.star_matrix import StarMatrix
 from albedo_tpu.ops.als import als_fit_fused, als_init_fit_fused
 from albedo_tpu.ops.topk import topk_scores
+from albedo_tpu.utils.aot import persistent_aot_call
 
 
 class ALSModel:
@@ -120,6 +131,15 @@ def _landing_perm(buckets: list[Bucket], n_target: int) -> np.ndarray:
     return landing
 
 
+# Weakref-keyed per-matrix caches (ADVICE r5 #1): keyed by id() with a
+# finalizer that drops the entry when the matrix is collected, so a
+# long-lived process fitting many matrices releases each one's uploaded
+# device buckets with the matrix instead of accumulating them. (A
+# WeakKeyDictionary won't do: the frozen dataclass's field-tuple __hash__
+# would try to hash ndarrays.)
+_LAYOUT_CACHES: dict[int, tuple[Any, dict]] = {}
+
+
 def _matrix_cache(matrix: StarMatrix) -> dict:
     """Per-matrix memo for bucket layouts and uploaded device groups.
 
@@ -127,10 +147,27 @@ def _matrix_cache(matrix: StarMatrix) -> dict:
     function of it + the layout knobs, so the same artifact-memoization
     philosophy as ``loadOrCreate*`` (``utils/ModelUtils.scala:7-21``) applies:
     a warmup fit leaves the layouts (and their one-time device upload) warm
-    for the real fit. The frozen dataclass's ``__dict__`` carries the cache
-    (bypassing the frozen ``__setattr__`` is intentional — the cache is not
-    part of the value)."""
-    return matrix.__dict__.setdefault("_als_layout_cache", {})
+    for the real fit. The cache lives exactly as long as the matrix (see
+    ``_LAYOUT_CACHES``)."""
+    key = id(matrix)
+    entry = _LAYOUT_CACHES.get(key)
+    # The ref check guards id reuse: a dead matrix's id can be recycled
+    # before its finalizer has run on exotic GC interleavings.
+    if entry is not None and entry[0]() is matrix:
+        return entry[1]
+    cache: dict = {}
+    _LAYOUT_CACHES[key] = (weakref.ref(matrix), cache)
+    weakref.finalize(matrix, _LAYOUT_CACHES.pop, key, None)
+    return cache
+
+
+def _bucket_workers() -> int | None:
+    """Host fill-thread count: ``ALBEDO_BUCKET_WORKERS`` (0/1 = sequential),
+    default = CPU count. The scatter fills are pure NumPy and release the
+    GIL, so threads scale until memory bandwidth saturates."""
+    raw = os.environ.get("ALBEDO_BUCKET_WORKERS")
+    n = int(raw) if raw else (os.cpu_count() or 1)
+    return n if n > 1 else None
 
 
 @dataclasses.dataclass
@@ -171,24 +208,39 @@ class ImplicitALS:
     # (utils.checkpoint.checkpointed_als_fit) instead of the seeded init.
     init_factors: tuple | None = None
 
+    def _layout_kwargs(self) -> dict:
+        return dict(
+            batch_size=self.batch_size,
+            max_entries=self.max_entries,
+            max_len=self.max_len,
+        )
+
     def _host_buckets(self, matrix: StarMatrix) -> tuple[list, list]:
         """(user, item) bucket lists — the exact layouts ``fit`` trains on.
 
         Memoized per matrix (see ``_matrix_cache``): bucketing is a pure
         function of the immutable matrix + layout knobs, so a warmup fit
-        leaves the layout warm for the timed fit."""
+        leaves the layout warm for the timed fit. The CSR (user) and CSC
+        (item) sides run concurrently and each side's per-bucket scatter
+        fills shard across a thread pool (``_bucket_workers``) — output is
+        byte-identical to the sequential build."""
         key = ("host", self.batch_size, self.max_entries, self.max_len)
         cache = _matrix_cache(matrix)
         if key not in cache:
-            cache[key] = tuple(
-                bucket_rows(
-                    *csx,
-                    batch_size=self.batch_size,
-                    max_entries=self.max_entries,
-                    max_len=self.max_len,
+            workers = _bucket_workers()
+            if workers:
+                # Split the worker budget across the two concurrent sides so
+                # the total fill-thread count stays at the host budget.
+                kw = dict(self._layout_kwargs(), workers=max(1, workers // 2))
+                with ThreadPoolExecutor(max_workers=2) as sides:
+                    user_f = sides.submit(lambda: bucket_rows(*matrix.csr(), **kw))
+                    item_f = sides.submit(lambda: bucket_rows(*matrix.csc(), **kw))
+                    cache[key] = (user_f.result(), item_f.result())
+            else:
+                cache[key] = tuple(
+                    bucket_rows(*csx, **self._layout_kwargs())
+                    for csx in (matrix.csr(), matrix.csc())
                 )
-                for csx in (matrix.csr(), matrix.csc())
-            )
         return cache[key]
 
     def _groups_cache_key(self) -> tuple:
@@ -213,50 +265,139 @@ class ImplicitALS:
         per-row solves across devices and inserts the all-gather when solved
         rows land in the replicated factor tables — the compiler-inserted
         version of ``parallel.als.ShardedALSSweep``'s explicit shard_map.
+
+        Cold-path pipeline (the r5 20.1 s single-threaded cliff): CSR and CSC
+        sides bucket concurrently, per-bucket scatter fills shard across a
+        thread pool, each finished shape group starts its (async)
+        ``jax.device_put`` while later groups are still being packed, and the
+        landing permutations are built while those transfers are in flight.
+        ``self.last_prep_timings`` records the split: ``bucket_s`` (host
+        planning + fills) and ``upload_s`` (upload dispatch + landing build;
+        the transfers themselves overlap the packing).
         """
         key = self._groups_cache_key()
         cache = _matrix_cache(matrix)
         if key in cache:
+            self.last_prep_timings = {"bucket_s": 0.0, "upload_s": 0.0}
             return cache[key]
 
-        user_buckets, item_buckets = self._host_buckets(matrix)
-        sharding = None
-        landing_sharding = None
         if self.mesh is not None:
-            from jax.sharding import NamedSharding, PartitionSpec as P
+            cache[key] = self._device_groups_mesh(matrix)
+            return cache[key]
 
-            from albedo_tpu.parallel.als import pad_bucket
-            from albedo_tpu.parallel.mesh import DATA_AXIS, replicated
+        workers = _bucket_workers()
+        t0 = time.perf_counter()
+        with ThreadPoolExecutor(max_workers=2) as sides:
+            csr_f = sides.submit(matrix.csr)
+            csc_f = sides.submit(matrix.csc)
+            csr, csc = csr_f.result(), csc_f.result()
 
-            n_dev = self.mesh.shape[DATA_AXIS]
-            user_buckets = [pad_bucket(b, n_dev) for b in user_buckets]
-            item_buckets = [pad_bucket(b, n_dev) for b in item_buckets]
-            # Leading axis = stacked same-shape buckets; batch axis sharded
-            # (specs shorter than the rank replicate trailing dims).
-            sharding = NamedSharding(self.mesh, P(None, DATA_AXIS))
-            landing_sharding = replicated(self.mesh)
+        def put(g: Bucket) -> tuple:
+            d = device_bucket(g)
+            return (d.row_ids, d.idx, d.val, d.mask)
+
+        # Both sides pack concurrently, so each gets half the fill-thread
+        # budget — total threads stay at the host budget, not 2x it.
+        side_workers = None if workers is None else max(1, workers // 2)
+
+        def build_side(csx, n_target):
+            """Pack one side's groups, uploading each as soon as it's full;
+            returns (device groups, device landing, upload dispatch secs)."""
+            device_groups: list[tuple] = []
+            upload_s = [0.0]
+
+            def on_group(_i, g):
+                s = time.perf_counter()
+                device_groups.append(put(g))  # device_put is async: transfer
+                upload_s[0] += time.perf_counter() - s  # overlaps later packing
+            grouped = grouped_bucket_rows(
+                *csx, **self._layout_kwargs(), workers=side_workers, on_group=on_group
+            )
+            # Landing perm is pure host work — runs while H2D is in flight.
+            landing = _landing_perm(grouped, n_target)
+            s = time.perf_counter()
+            landing_dev = jax.device_put(landing)
+            upload_s[0] += time.perf_counter() - s
+            return device_groups, landing_dev, upload_s[0]
+
+        if workers:
+            with ThreadPoolExecutor(max_workers=2) as sides:
+                user_f = sides.submit(build_side, csr, matrix.n_users)
+                item_f = sides.submit(build_side, csc, matrix.n_items)
+                ug, u_land, u_up = user_f.result()
+                ig, i_land, i_up = item_f.result()
+        else:
+            ug, u_land, u_up = build_side(csr, matrix.n_users)
+            ig, i_land, i_up = build_side(csc, matrix.n_items)
+        total = time.perf_counter() - t0
+        upload = u_up + i_up
+        self.last_prep_timings = {
+            "bucket_s": round(max(0.0, total - upload), 4),
+            "upload_s": round(upload, 4),
+        }
+        cache[key] = (ug, ig, u_land, i_land)
+        return cache[key]
+
+    def _device_groups_mesh(self, matrix: StarMatrix) -> tuple:
+        """Mesh layout path: pad buckets to a device-count multiple, then
+        group/upload with the sharded layout. Host fills still run threaded
+        via ``_host_buckets``; the per-group pipeline stays single-stream
+        because ``pad_bucket`` operates on ungrouped buckets."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from albedo_tpu.parallel.als import pad_bucket
+        from albedo_tpu.parallel.mesh import DATA_AXIS, replicated
+
+        t0 = time.perf_counter()
+        user_buckets, item_buckets = self._host_buckets(matrix)
+        n_dev = self.mesh.shape[DATA_AXIS]
+        user_buckets = [pad_bucket(b, n_dev) for b in user_buckets]
+        item_buckets = [pad_bucket(b, n_dev) for b in item_buckets]
+        # Leading axis = stacked same-shape buckets; batch axis sharded
+        # (specs shorter than the rank replicate trailing dims).
+        sharding = NamedSharding(self.mesh, P(None, DATA_AXIS))
+        landing_sharding = replicated(self.mesh)
 
         user_grouped = group_buckets(user_buckets)
         item_grouped = group_buckets(item_buckets)
         user_landing = _landing_perm(user_grouped, matrix.n_users)
         item_landing = _landing_perm(item_grouped, matrix.n_items)
+        t1 = time.perf_counter()
 
         def put(g):
             d = device_bucket(g, sharding)
             return (d.row_ids, d.idx, d.val, d.mask)
 
-        def put_landing(x):
-            if landing_sharding is not None:
-                return jax.device_put(x, landing_sharding)
-            return jax.device_put(x)
-
-        cache[key] = (
+        out = (
             [put(g) for g in user_grouped],
             [put(g) for g in item_grouped],
-            put_landing(user_landing),
-            put_landing(item_landing),
+            jax.device_put(user_landing, landing_sharding),
+            jax.device_put(item_landing, landing_sharding),
         )
-        return cache[key]
+        t2 = time.perf_counter()
+        self.last_prep_timings = {
+            "bucket_s": round(t1 - t0, 4),
+            "upload_s": round(t2 - t1, 4),
+        }
+        return out
+
+    def _aot_key_parts(self, fn_name: str, matrix: StarMatrix, ug, ig) -> tuple:
+        """Executable identity for the persistent AOT cache: everything the
+        compiled program depends on beyond the dynamic argument values —
+        bucket-shape signature, factor-table sizes, solver statics, mesh
+        layout, and backend. Seed/reg/alpha/max_iter are traced arguments,
+        so one executable serves any of their values."""
+        dev = jax.devices()[0]
+        groups_sig = tuple(tuple(g[1].shape) for g in ug) + ("|",) + tuple(
+            tuple(g[1].shape) for g in ig
+        )
+        return (
+            fn_name, jax.__version__, jax.default_backend(),
+            getattr(dev, "device_kind", "?"), len(jax.devices()),
+            None if self.mesh is None else repr(self.mesh),
+            self.solver, self.cg_steps, self.gather_dtype, self.rank,
+            matrix.n_users, matrix.n_items, groups_sig,
+        )
 
     def fit(self, matrix: StarMatrix, callback: Any | None = None) -> ALSModel:
         """Train factors on the default backend, or sharded over ``self.mesh``.
@@ -268,32 +409,39 @@ class ImplicitALS:
         return (``block_until_ready``) — host copies materialize lazily via
         the ``ALSModel`` properties. ``self.last_fit_report`` records the
         wall-clock split: ``prep_s`` (bucket layout + one-time device upload;
-        ~0 when the per-matrix cache is warm), ``device_s`` (the fused
-        training dispatch, synchronized), ``prep_cached`` (whether the layout
-        cache was warm).
+        ~0 when the per-matrix cache is warm) with its ``bucket_s``/
+        ``upload_s`` parts, ``compile_s`` (AOT executable acquisition — 0 on
+        an in-memory hit; ``compile_source`` says memory/disk/compile),
+        ``device_s`` (the fused training dispatch, synchronized), and
+        ``prep_cached`` (whether the layout cache was warm).
         """
-        import time
-
         t0 = time.perf_counter()
         cache_warm = self._groups_cache_key() in _matrix_cache(matrix)
         ug, ig, u_land, i_land = self.device_groups(matrix)
+        prep_split = dict(getattr(self, "last_prep_timings", {}))
         t1 = time.perf_counter()
 
         reg = jnp.float32(self.reg_param)
         alpha = jnp.float32(self.alpha)
-        kwargs = dict(
-            solver=self.solver, cg_steps=self.cg_steps,
-            user_landing=u_land, item_landing=i_land,
-            gather_dtype=self.gather_dtype,
-        )
+        compile_s = 0.0
+        compile_source = None
         if self.init_factors is None and callback is None:
             # Seeded init fused into the training program: the whole fit is
-            # ONE dispatch (ops.als.als_init_fit_fused).
-            user_f, item_f = als_init_fit_fused(
-                jax.random.PRNGKey(self.seed), ug, ig, reg, alpha,
-                jnp.int32(self.max_iter),
-                n_users=matrix.n_users, n_items=matrix.n_items, rank=self.rank,
-                **kwargs,
+            # ONE dispatch (ops.als.als_init_fit_fused), AOT-compiled through
+            # the persistent executable cache (utils.aot) so a fresh process
+            # with the same bucket layout skips the trace+compile entirely.
+            (user_f, item_f), compile_s, compile_source = persistent_aot_call(
+                als_init_fit_fused,
+                args=(jax.random.PRNGKey(self.seed), ug, ig, reg, alpha,
+                      jnp.int32(self.max_iter)),
+                dyn_kwargs=dict(user_landing=u_land, item_landing=i_land),
+                static_kwargs=dict(
+                    n_users=matrix.n_users, n_items=matrix.n_items,
+                    rank=self.rank, solver=self.solver, cg_steps=self.cg_steps,
+                    gather_dtype=self.gather_dtype,
+                ),
+                key_parts=self._aot_key_parts("als_init_fit_fused", matrix, ug, ig),
+                name="als_init_fit_fused",
             )
         else:
             if self.init_factors is not None:
@@ -311,9 +459,17 @@ class ImplicitALS:
                 user_f = jax.device_put(user_f, replicated(self.mesh))
                 item_f = jax.device_put(item_f, replicated(self.mesh))
             if callback is None:
-                user_f, item_f = als_fit_fused(
-                    user_f, item_f, ug, ig, reg, alpha, jnp.int32(self.max_iter),
-                    **kwargs,
+                (user_f, item_f), compile_s, compile_source = persistent_aot_call(
+                    als_fit_fused,
+                    args=(user_f, item_f, ug, ig, reg, alpha,
+                          jnp.int32(self.max_iter)),
+                    dyn_kwargs=dict(user_landing=u_land, item_landing=i_land),
+                    static_kwargs=dict(
+                        solver=self.solver, cg_steps=self.cg_steps,
+                        gather_dtype=self.gather_dtype,
+                    ),
+                    key_parts=self._aot_key_parts("als_fit_fused", matrix, ug, ig),
+                    name="als_fit_fused",
                 )
             else:
                 # One fused dispatch per iteration (same executable: n_iter is
@@ -321,7 +477,9 @@ class ImplicitALS:
                 for it in range(self.max_iter):
                     user_f, item_f = als_fit_fused(
                         user_f, item_f, ug, ig, reg, alpha, jnp.int32(1),
-                        **kwargs,
+                        solver=self.solver, cg_steps=self.cg_steps,
+                        user_landing=u_land, item_landing=i_land,
+                        gather_dtype=self.gather_dtype,
                     )
                     callback(it, np.asarray(user_f), np.asarray(item_f))
         # Synchronize via a tiny device->host read of values that depend on
@@ -333,7 +491,11 @@ class ImplicitALS:
         t2 = time.perf_counter()
         self.last_fit_report = {
             "prep_s": round(t1 - t0, 4),
-            "device_s": round(t2 - t1, 4),
+            "bucket_s": prep_split.get("bucket_s", 0.0),
+            "upload_s": prep_split.get("upload_s", 0.0),
+            "compile_s": round(compile_s, 4),
+            "compile_source": compile_source,
+            "device_s": round(t2 - t1 - compile_s, 4),
             "prep_cached": bool(cache_warm),
         }
 

@@ -97,7 +97,14 @@ def bucket_solve_body(
     n_b = mask.sum(axis=1).astype(jnp.float32)
     eye = jnp.eye(k, dtype=jnp.float32)
     a_mat = yty[None] + corr + (reg * n_b)[:, None, None] * eye
-    b_vec = _gdot("blk,bl->bk", gathered, w)
+    # b-vector weights stay float32 even under bf16 gathers: w = 1 + alpha*r
+    # spends ~8 significant bits on the integer part alone, so a bf16 cast
+    # adds ~0.4% relative error per entry (ADVICE r5 #3). The MXU consumes
+    # mixed bf16/f32 inputs with f32 accumulation natively — only the big
+    # gathered block needs the reduced dtype to save bandwidth.
+    b_vec = jnp.einsum(
+        "blk,bl->bk", gathered, w, preferred_element_type=jnp.float32
+    )
 
     chol = jnp.linalg.cholesky(a_mat)
     return jax.scipy.linalg.cho_solve((chol, True), b_vec[..., None])[..., 0]
@@ -133,7 +140,10 @@ def bucket_cg_body(
     c1 = alpha * val                            # (B, L); 0 on padding
     w = jnp.where(mask, 1.0 + c1, 0.0)
     n_b = mask.sum(axis=1).astype(jnp.float32)
-    b_vec = _gdot("blk,bl->bk", gathered, w)
+    # f32 weights for the b-vector under bf16 gathers — see bucket_solve_body.
+    b_vec = jnp.einsum(
+        "blk,bl->bk", gathered, w, preferred_element_type=jnp.float32
+    )
 
     # Jacobi preconditioner: diag(A) = diag(YtY) + sum_l c1 y_l^2 + reg n.
     diag = (

@@ -1,0 +1,158 @@
+"""Cold-start pipeline: parallel bucket-build determinism, the grouped
+direct-to-slab builder, the fit-report stage split, the AOT export/import
+round trip, and the bounded caches (ISSUE 1 acceptance gates)."""
+
+import gc
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+import bench  # noqa: E402
+from albedo_tpu.datasets.ragged import (  # noqa: E402
+    bucket_rows,
+    group_buckets,
+    grouped_bucket_rows,
+)
+from albedo_tpu.datasets.synthetic import synthetic_stars  # noqa: E402
+from albedo_tpu.models.als import _LAYOUT_CACHES, ImplicitALS  # noqa: E402
+from albedo_tpu.utils.aot import LRUCache, reset_memory_cache  # noqa: E402
+
+FIELDS = ("row_ids", "idx", "val", "mask")
+
+
+def assert_buckets_identical(a, b):
+    assert len(a) == len(b)
+    for x, y in zip(a, b):
+        for f in FIELDS:
+            fx, fy = getattr(x, f), getattr(y, f)
+            assert fx.dtype == fy.dtype and fx.shape == fy.shape
+            assert fx.tobytes() == fy.tobytes(), f
+
+
+def test_parallel_bucket_rows_byte_identical():
+    """The thread-pool fill path must produce byte-identical buckets to the
+    sequential path on both CSR (user) and CSC (item) inputs — the
+    determinism gate of the cold-path pipeline."""
+    m = synthetic_stars(n_users=500, n_items=260, mean_stars=14, seed=31)
+    for csx in (m.csr(), m.csc()):
+        seq = bucket_rows(*csx, batch_size=64, max_entries=1 << 14)
+        par = bucket_rows(*csx, batch_size=64, max_entries=1 << 14, workers=4)
+        assert_buckets_identical(seq, par)
+
+
+def test_parallel_bucket_rows_byte_identical_with_max_len():
+    m = synthetic_stars(n_users=300, n_items=150, mean_stars=10, seed=7)
+    csx = m.csr()
+    seq = bucket_rows(*csx, batch_size=32, max_len=5, len_multiple=4)
+    par = bucket_rows(*csx, batch_size=32, max_len=5, len_multiple=4, workers=3)
+    assert_buckets_identical(seq, par)
+
+
+def test_grouped_builder_matches_group_buckets():
+    """Filling straight into the stacked group slabs must equal
+    group_buckets(bucket_rows(...)) byte-for-byte, and the on_group hook must
+    fire once per group in shape-sorted order (the upload-pipeline contract)."""
+    m = synthetic_stars(n_users=400, n_items=200, mean_stars=12, seed=13)
+    for csx in (m.csr(), m.csc()):
+        ref = group_buckets(bucket_rows(*csx, batch_size=64, max_entries=1 << 13))
+        for workers in (None, 3):
+            seen = []
+            got = grouped_bucket_rows(
+                *csx, batch_size=64, max_entries=1 << 13, workers=workers,
+                on_group=lambda i, g: seen.append(i),
+            )
+            assert seen == list(range(len(got)))
+            assert_buckets_identical(ref, got)
+
+
+def test_fit_report_cold_split_fields():
+    """The fit report must carry the cold-path stage split; a second fit on
+    the same matrix reports a warm layout cache and a memory-cache compile."""
+    m = synthetic_stars(n_users=80, n_items=50, mean_stars=6, seed=29)
+    als = ImplicitALS(rank=4, max_iter=2, seed=0)
+    als.fit(m)
+    r = als.last_fit_report
+    assert {"prep_s", "bucket_s", "upload_s", "compile_s", "compile_source",
+            "device_s", "prep_cached"} <= set(r)
+    assert r["prep_cached"] is False
+    assert r["compile_s"] >= 0.0 and r["compile_source"] in ("compile", "disk")
+    als2 = ImplicitALS(rank=4, max_iter=2, seed=0)
+    als2.fit(m)
+    r2 = als2.last_fit_report
+    assert r2["prep_cached"] is True
+    assert r2["bucket_s"] == 0.0 and r2["upload_s"] == 0.0
+    assert r2["compile_source"] == "memory" and r2["compile_s"] == 0.0
+
+
+def test_cold_prep_bench_record_shape():
+    """cold_prep totals the split and prices it against the r5 cliff."""
+    rec = bench.cold_prep_record(
+        {"prep_s": 1.0, "bucket_s": 0.6, "upload_s": 0.4, "compile_s": 2.0,
+         "compile_source": "compile", "device_s": 0.345, "prep_cached": False}
+    )
+    assert rec["total_s"] == pytest.approx(3.345)
+    assert rec["r5_cold_total_s"] == bench.R5_COLD_PREP_S
+    assert rec["speedup_vs_r5"] == pytest.approx(bench.R5_COLD_PREP_S / 3.345, abs=0.01)
+    # The split fields ride through untouched.
+    assert rec["bucket_s"] == 0.6 and rec["upload_s"] == 0.4
+
+
+def test_aot_export_roundtrip_identical_factors():
+    """A second process (simulated by clearing the in-memory executable LRU)
+    must load the serialized export from disk and produce factors identical
+    to the fresh compile's. Uses the CG solver — its program has no custom
+    calls, so the disk layer engages on every backend."""
+    m = synthetic_stars(n_users=90, n_items=60, mean_stars=6, seed=17)
+    als = ImplicitALS(rank=4, max_iter=3, seed=5, solver="cg")
+    first = als.fit(m)
+    assert als.last_fit_report["compile_source"] == "compile"
+
+    reset_memory_cache()
+    als2 = ImplicitALS(rank=4, max_iter=3, seed=5, solver="cg")
+    second = als2.fit(m)
+    assert als2.last_fit_report["compile_source"] == "disk"
+    np.testing.assert_array_equal(first.user_factors, second.user_factors)
+    np.testing.assert_array_equal(first.item_factors, second.item_factors)
+
+
+def test_aot_skips_disk_for_custom_call_programs():
+    """On CPU the Cholesky solve lowers to a LAPACK custom call, which is not
+    round-trip-safe (executing a deserialized copy in a fresh process can
+    crash): such programs must stay memory-cached only — a second cold
+    acquisition recompiles instead of reading a blob."""
+    from albedo_tpu.utils.aot import export_dir
+
+    m = synthetic_stars(n_users=90, n_items=60, mean_stars=6, seed=19)
+    als = ImplicitALS(rank=4, max_iter=2, seed=1, solver="cholesky")
+    als.fit(m)
+    assert als.last_fit_report["compile_source"] == "compile"
+    assert not list(export_dir().glob("als_init_fit_fused-*.jaxexport"))
+
+    reset_memory_cache()
+    als2 = ImplicitALS(rank=4, max_iter=2, seed=1, solver="cholesky")
+    als2.fit(m)
+    assert als2.last_fit_report["compile_source"] == "compile"
+
+
+def test_lru_cache_bounds_and_recency():
+    c = LRUCache(maxsize=2)
+    c.put("a", 1)
+    c.put("b", 2)
+    assert c.get("a") == 1  # refresh recency: b is now oldest
+    c.put("c", 3)
+    assert len(c) == 2
+    assert "b" not in c and "a" in c and "c" in c
+
+
+def test_matrix_cache_released_with_matrix():
+    """The device-group cache must die with its matrix (ADVICE r5 #1): a
+    long-lived process fitting many matrices must not accumulate uploads."""
+    m = synthetic_stars(n_users=40, n_items=30, mean_stars=4, seed=3)
+    ImplicitALS(rank=4, max_iter=1, seed=0).fit(m)
+    key = id(m)
+    assert key in _LAYOUT_CACHES
+    del m
+    gc.collect()
+    assert key not in _LAYOUT_CACHES
