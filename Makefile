@@ -9,16 +9,26 @@ JOBS = popularity curation content train_als cv_als build_user_profile \
        build_repo_profile train_word2vec train_lr cv_lr item_cf user_cf \
        tfidf_content ranking_mf collect_data drop_data sync_index serve play
 
-.PHONY: $(JOBS) test bench dryrun
+.PHONY: $(JOBS) test test-all bench serve-bench dryrun
 
 $(JOBS):
 	$(PY) -m albedo_tpu.cli $@ $(ARGS)
 
+# Tier-1: the slow-marked load tests run via test-all, not here.
 test:
+	$(PY) -m pytest tests/ -q -m 'not slow'
+
+test-all:
 	$(PY) -m pytest tests/ -q
 
 bench:
 	$(PY) bench.py
+
+# Online-engine scenario: micro-batched vs per-request throughput/p50/p99
+# under concurrent load (env knobs: ALBEDO_SERVE_USERS/ITEMS/CONCURRENCY/
+# DURATION/TRIALS/K).
+serve-bench:
+	$(PY) bench.py serving
 
 dryrun:
 	$(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('ok')"

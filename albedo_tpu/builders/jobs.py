@@ -230,6 +230,32 @@ class JobContext:
             )
         return self._cache["w2v"]
 
+    def ranker_model(self):
+        """Trained LR :class:`~albedo_tpu.builders.ranker.RankerModel` for
+        online re-ranking (``serve --two-stage``). Trained in-process and
+        cached per context — the model holds live pipeline stages (w2v, LR
+        device arrays), so it memoizes here rather than through the pickle
+        store; its ingredients (ALS factors, w2v vectors) still come from
+        their date-keyed artifacts."""
+        if "ranker" not in self._cache:
+            from albedo_tpu.builders.ranker import RankerConfig, train_ranker
+
+            up, uc, rp, rc = self.profiles()
+            lo, hi = self.star_range()
+            config = RankerConfig(
+                popular_min_stars=lo, popular_max_stars=hi,
+                min_df=3 if self.small else 10,
+            )
+            if self.small:
+                config = config.small()
+            result = train_ranker(
+                self.tables(), up, uc, rp, rc, self.als_model(), self.matrix(),
+                self.word2vec(), now=self.now, config=config,
+            )
+            print(f"[serve] ranker trained: AUC = {result.auc:.4f}")
+            self._cache["ranker"] = result.model
+        return self._cache["ranker"]
+
     def test_user_dense(self, n=250) -> np.ndarray:
         matrix = self.matrix()
         canary = matrix.users_of(np.array([VINTA_USER_ID]))
@@ -545,30 +571,64 @@ def tfidf_content_job(args) -> None:
 
 @register_job("serve")
 def serve_job(args) -> None:
-    """Django web-layer parity (``app/views.py``, ``app/urls.py``,
-    ``app/admin.py``): serve the index page, top-k recommendations from the
-    trained ALS artifacts, and admin-style repo/user search over HTTP.
+    """The online inference engine over trained artifacts: micro-batched
+    top-k, optional two-stage candidate fan-out + LR re-rank, TTL result
+    cache, and the `/metrics` Prometheus plane (``albedo_tpu.serving``).
 
     Extra flags: --port N (default 8080), --host ADDR (default 127.0.0.1;
-    use 0.0.0.0 inside containers), --duration SECONDS (0 = forever).
+    use 0.0.0.0 inside containers), --duration SECONDS (0 = forever),
+    --no-batch (direct per-request GEMMs, the seed path), --no-warm (skip
+    pre-compiling the batch-shape ladder), --two-stage (register the
+    popularity + curation candidate sources and train/load the LR ranker
+    for online re-ranking), --cache-ttl SECONDS (default 30; 0 disables),
+    --max-batch N (default 64), --window-ms MS (batching window, default 2).
     """
+    from albedo_tpu.recommenders import CurationRecommender, PopularityRecommender
     from albedo_tpu.serving import RecommendationService, serve
 
     extra = argparse.ArgumentParser()
     extra.add_argument("--port", type=int, default=8080)
     extra.add_argument("--host", default="127.0.0.1")
     extra.add_argument("--duration", type=float, default=0.0)
+    extra.add_argument("--no-batch", action="store_true")
+    extra.add_argument("--no-warm", action="store_true")
+    extra.add_argument("--two-stage", action="store_true")
+    extra.add_argument("--cache-ttl", type=float, default=30.0)
+    extra.add_argument("--max-batch", type=int, default=64)
+    extra.add_argument("--window-ms", type=float, default=2.0)
     ns, _ = extra.parse_known_args(getattr(args, "_rest", []))
 
     ctx = JobContext(args)
+    recommenders = None
+    ranker = None
+    if ns.two_stage:
+        lo, hi = ctx.star_range()
+        recommenders = {
+            "popularity": PopularityRecommender(
+                popular_repos(ctx.tables().repo_info, lo, hi), top_k=TOP_K
+            ),
+            "curation": CurationRecommender(
+                ctx.tables().starring,
+                **({"curator_ids": ctx.curators()} if ctx.curators() else {}),
+                top_k=TOP_K,
+            ),
+        }
+        ranker = ctx.ranker_model()
     service = RecommendationService(
         ctx.als_model(), ctx.matrix(),
         repo_info=ctx.tables().repo_info, user_info=ctx.tables().user_info,
+        recommenders=recommenders, ranker=ranker,
+        batching=not ns.no_batch, warm=not ns.no_batch and not ns.no_warm,
+        cache_ttl=ns.cache_ttl, max_batch=ns.max_batch,
+        batch_window_ms=ns.window_ms,
     )
     server = serve(service, host=ns.host, port=ns.port)
     host, port = server.server_address[:2]
+    mode = "two-stage" if ns.two_stage else "als"
     print(f"[serve] listening on http://{host}:{port}/ "
-          f"(/recommend/<user_id>, /admin/repos, /admin/users)")
+          f"(/recommend/<user_id>, /admin/repos, /admin/users, /metrics) "
+          f"[{mode}, batching={'off' if ns.no_batch else 'on'}, "
+          f"cache_ttl={ns.cache_ttl:g}s]")
     try:
         if ns.duration > 0:
             time.sleep(ns.duration)

@@ -195,8 +195,7 @@ class FeatureMatrix:
         return np.concatenate(out, axis=1)
 
 
-def _pow2_at_least(n: int) -> int:
-    return 1 << max(0, int(n - 1).bit_length())
+from albedo_tpu.utils import pow2_at_least as _pow2_at_least
 
 
 class FeatureAssemblerModel(Transformer):

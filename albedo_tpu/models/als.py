@@ -53,6 +53,8 @@ class ALSModel:
         self.rank = int(rank)
         self._uf_np: np.ndarray | None = None
         self._vf_np: np.ndarray | None = None
+        self._dev: tuple[jax.Array, jax.Array] | None = None
+        self._vf_dev: jax.Array | None = None
 
     @property
     def user_factors(self) -> np.ndarray:  # (n_users, rank) float32
@@ -65,6 +67,35 @@ class ALSModel:
         if self._vf_np is None:
             self._vf_np = np.asarray(self._vf_raw, dtype=np.float32)
         return self._vf_np
+
+    def device_factors(self) -> tuple[jax.Array, jax.Array]:
+        """Device-resident ``(user_factors, item_factors)``, uploaded once
+        and cached — the serving batcher's explicit opt-in: it scores every
+        request against the same tables, so pinning the full user table on
+        device is the right trade there. Offline ``recommend()`` callers do
+        NOT pay this pin for host-backed models (see below)."""
+        if self._dev is None:
+            uf = (
+                self._uf_raw
+                if isinstance(self._uf_raw, jax.Array)
+                else jnp.asarray(self.user_factors)
+            )
+            self._dev = (uf, self._device_items())
+        return self._dev
+
+    def _device_items(self) -> jax.Array:
+        """Device-resident item table only — cached so repeat ``recommend``
+        calls stop re-uploading it (the seed paid that per call), without
+        pinning the much larger user table for one-shot offline scoring."""
+        if self._dev is not None:
+            return self._dev[1]
+        if self._vf_dev is None:
+            self._vf_dev = (
+                self._vf_raw
+                if isinstance(self._vf_raw, jax.Array)
+                else jnp.asarray(self.item_factors)
+            )
+        return self._vf_dev
 
     def predict(self, rows: np.ndarray, cols: np.ndarray) -> np.ndarray:
         u = self.user_factors[np.asarray(rows)]
@@ -87,13 +118,14 @@ class ALSModel:
             # jnp.take's default clipping would silently score a wrong user.
             raise IndexError(f"user index out of range [0, {n}): {ui.min()}..{ui.max()}")
         if isinstance(self._uf_raw, jax.Array):
-            # Factors already device-resident: gather on device, skip the
-            # host round-trip entirely.
+            # Factors already device-resident: gather on device.
             uf = jnp.take(self._uf_raw, jnp.asarray(ui), axis=0)
-            vf = self._vf_raw
         else:
-            uf = jnp.asarray(self.user_factors[np.asarray(user_indices)])
-            vf = jnp.asarray(self.item_factors)
+            # Host-backed (unpickled artifacts): upload only the requested
+            # rows — offline evaluate/cv callers score a few hundred users
+            # once, so pinning the full user table here would be pure waste.
+            uf = jnp.asarray(self.user_factors[ui])
+        vf = self._device_items()
         excl = None if exclude_idx is None else jnp.asarray(exclude_idx)
         vals, idx = topk_scores(uf, vf, k=k, exclude_idx=excl, item_block=item_block)
         return np.asarray(vals), np.asarray(idx)

@@ -39,9 +39,15 @@ def topk_scores(
 
     n_blocks = -(-n_items // item_block)
     padded = n_blocks * item_block
-    items_pad = jnp.zeros((padded, rank), dtype=item_factors.dtype)
-    items_pad = items_pad.at[:n_items].set(item_factors)
-    item_blocks = items_pad.reshape(n_blocks, item_block, rank)
+    if padded == n_items:
+        # Block-aligned item table: a pure reshape, no zero-fill + scatter.
+        # The serving micro-batcher calls this once per coalesced batch, so
+        # the aligned case is a per-batch copy saved, not a one-off.
+        item_blocks = item_factors.reshape(n_blocks, item_block, rank)
+    else:
+        items_pad = jnp.zeros((padded, rank), dtype=item_factors.dtype)
+        items_pad = items_pad.at[:n_items].set(item_factors)
+        item_blocks = items_pad.reshape(n_blocks, item_block, rank)
 
     neg_inf = jnp.asarray(-jnp.inf, dtype=user_factors.dtype)
     init_vals = jnp.full((n_users, k), neg_inf, dtype=user_factors.dtype)

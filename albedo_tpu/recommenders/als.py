@@ -70,9 +70,4 @@ class ALSRecommender(Recommender):
                 rows, k=self.top_k, exclude_idx=excl, item_block=self.item_block
             )
 
-        k = vals.shape[1]
-        ok = (idx >= 0).ravel() & np.isfinite(vals).ravel()
-        flat_users = np.repeat(users, k)[ok]
-        flat_items = self.matrix.item_ids[idx.ravel().clip(min=0)][ok]
-        flat_scores = vals.ravel()[ok]
-        return self._frame(flat_users, flat_items, flat_scores)
+        return self._topk_frame(users, vals, idx, self.matrix.item_ids)

@@ -40,6 +40,25 @@ class Recommender(Transformer):
         self.require_cols(df, [self.user_col])
         return self.recommend_for_users(df[self.user_col].to_numpy(np.int64))
 
+    def _topk_frame(
+        self,
+        users: np.ndarray,
+        vals: np.ndarray,
+        idx: np.ndarray,
+        item_ids: np.ndarray,
+    ) -> pd.DataFrame:
+        """Flatten ``(U, k)`` device top-k output into the candidate frame.
+
+        Masks BEFORE gathering ``item_ids``: -1 sentinels and -inf pad
+        entries (whose indices can be >= n_items when k exceeds the catalog)
+        must never reach the gather — shared by the offline ALS recommender
+        and the serving batcher's source so the invariant lives once."""
+        k = vals.shape[1]
+        ok = (idx >= 0) & np.isfinite(vals)
+        return self._frame(
+            np.repeat(users, k)[ok.ravel()], item_ids[idx[ok]], vals[ok]
+        )
+
     def _frame(
         self, users: np.ndarray, items: np.ndarray, scores: np.ndarray
     ) -> pd.DataFrame:

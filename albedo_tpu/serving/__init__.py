@@ -1,0 +1,41 @@
+"""Online inference engine (L5): micro-batched two-stage serving.
+
+The seed's ``serving.py`` module, promoted into a subsystem:
+
+- ``service``  — :class:`RecommendationService`, the artifact-backed engine
+- ``batcher``  — :class:`MicroBatcher`, dynamic request coalescing into
+  fixed-shape device batches (the ALX dense-batched-compute argument,
+  applied to serving)
+- ``pipeline`` — :class:`TwoStagePipeline`, online candidate fan-out + LR
+  re-rank with per-stage deadlines and graceful degradation
+- ``cache``    — :class:`TTLCache`, hot-user result cache
+- ``metrics``  — :class:`MetricsRegistry`, Prometheus ``/metrics`` plane
+- ``http``     — routes, hardening, load shedding, :func:`serve`
+
+The seed import surface (``from albedo_tpu.serving import
+RecommendationService, serve``) is unchanged.
+"""
+
+from albedo_tpu.serving.batcher import MicroBatcher, QueueOverflow
+from albedo_tpu.serving.cache import TTLCache
+from albedo_tpu.serving.http import ServerHandle, serve
+from albedo_tpu.serving.metrics import MetricsRegistry
+from albedo_tpu.serving.pipeline import (
+    BatchedALSSource,
+    StageDeadlines,
+    TwoStagePipeline,
+)
+from albedo_tpu.serving.service import RecommendationService
+
+__all__ = [
+    "BatchedALSSource",
+    "MetricsRegistry",
+    "MicroBatcher",
+    "QueueOverflow",
+    "RecommendationService",
+    "ServerHandle",
+    "StageDeadlines",
+    "TTLCache",
+    "TwoStagePipeline",
+    "serve",
+]

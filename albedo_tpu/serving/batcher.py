@@ -1,0 +1,391 @@
+"""Dynamic micro-batcher: coalesce concurrent top-k requests into one GEMM.
+
+The seed's serving layer ran one blocking ``(1, r) @ (r, I)`` GEMM + top-k
+per HTTP request — each request paying a full dispatch, with the device idle
+between requests. ALX (arxiv 2112.02194) gets its TPU throughput from dense
+fixed-shape batched compute; the same argument applies to serving: N
+concurrent requests for the same factor tables are ONE ``(N, r) @ (r, I)``
+GEMM away from each other.
+
+Mechanics:
+
+- ``submit()`` enqueues ``(dense_user, k, exclude_row)`` and returns a
+  ``concurrent.futures.Future``; the HTTP thread blocks on it.
+- A background worker pulls the first waiting request, then keeps collecting
+  until ``window_ms`` elapses or ``max_batch`` requests are in hand — the
+  classic dynamic-batching window: an isolated request pays at most the
+  window, a loaded server fills batches long before it.
+- Collected requests are grouped by ``(pow2(k), exclusion?)`` — the static
+  shape parameters — and each group is padded to a **power-of-two user
+  bucket** (row 0 repeated; padded rows are computed and discarded), so the
+  whole service runs on a small ladder of fixed shapes. ``k`` itself is
+  quantized up to a power of two and each request's rows are sliced back to
+  its own ``k``: the first j of an exact top-K are the exact top-j (same
+  scores, same value-desc/index-asc tie-break at any width), and the ladder
+  stays O(log max_k) — a client scanning k=1..500 can trigger at most ~9
+  distinct compiles ever, instead of one per k holding the worker hostage.
+- Each (bucket, k, exclusion-width) shape is compiled ONCE through
+  ``utils.aot.persistent_aot_executable`` and the executable handle is held
+  by the batcher — the hot path is ``compiled(user_idx, exclude)`` with no
+  tracing, no signature hashing, no cache lookup. ``warm()`` pre-compiles
+  the whole ladder at startup so no request ever pays a trace+compile.
+- Bounded queue: ``submit`` on a full queue raises :class:`QueueOverflow`
+  (the HTTP layer turns it into a 429) instead of letting latency collapse.
+
+Parity: the batched path must be byte-identical to the single-request path
+(``ALSModel.recommend``) — both gather user rows with ``jnp.take`` from the
+same device-resident tables and run the same ``ops.topk.topk_scores``
+program; per-user outputs are independent rows of the same GEMM. Pinned by
+``tests/test_serving_batcher.py``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import logging
+import queue
+import threading
+import time
+from concurrent.futures import Future
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from albedo_tpu.models.als import ALSModel
+from albedo_tpu.ops.topk import topk_scores
+from albedo_tpu.utils import pow2_at_least as _pow2_bucket
+from albedo_tpu.utils.aot import persistent_aot_executable
+
+log = logging.getLogger(__name__)
+
+
+class QueueOverflow(RuntimeError):
+    """The batcher's bounded request queue is full — shed load upstream."""
+
+
+@functools.partial(jax.jit, static_argnames=("k", "item_block"))
+def _gather_topk(uf_all, vf, user_idx, exclude_idx, k: int, item_block: int):
+    """One device program per batch: factor gather + blocked GEMM + top-k.
+
+    Keeping the gather inside the program means a batch is a single dispatch
+    end-to-end, and matches the single-request path's op sequence exactly
+    (``ALSModel.recommend``: ``jnp.take`` then ``topk_scores``)."""
+    uf = jnp.take(uf_all, user_idx, axis=0)
+    return topk_scores(uf, vf, k=k, exclude_idx=exclude_idx, item_block=item_block)
+
+
+@functools.partial(jax.jit, static_argnames=("k", "item_block"))
+def _gather_topk_device_excl(uf_all, vf, excl_all, user_idx, k: int, item_block: int):
+    """Batch program with DEVICE-side seen-item exclusion: the padded
+    exclusion table (every user's history, -1-padded) lives on device next
+    to the factor tables, so a request's exclusion rows are a gather inside
+    the program — no per-request host slicing, no per-batch host pad+upload.
+    Row contents match the host path's ``padded_rows`` exactly (same CSR
+    slice, same -1 padding), so results are identical."""
+    uf = jnp.take(uf_all, user_idx, axis=0)
+    excl = jnp.take(excl_all, user_idx, axis=0)
+    return topk_scores(uf, vf, k=k, exclude_idx=excl, item_block=item_block)
+
+
+@dataclasses.dataclass
+class _Request:
+    dense_user: int
+    k: int
+    # None = no exclusion; True = device-table exclusion; ndarray = host row.
+    exclude: "np.ndarray | bool | None"
+    future: Future
+
+
+_SENTINEL = object()
+
+
+class MicroBatcher:
+    """Background coalescing worker over a trained :class:`ALSModel`.
+
+    ``excl_width`` is the fixed exclusion-matrix width (power-of-two bucket
+    of the longest user history); every exclusion-bearing batch pads to it so
+    one executable per (bucket, k) covers all users.
+    """
+
+    def __init__(
+        self,
+        model: ALSModel,
+        exclude_table: np.ndarray | None = None,
+        excl_width: int = 0,
+        item_block: int = 4096,
+        max_batch: int = 64,
+        max_queue: int = 256,
+        window_ms: float = 2.0,
+        metrics=None,
+    ):
+        self.model = model
+        # Device-side exclusion: the full -1-padded seen-item table uploaded
+        # once; requests pass ``exclude=True`` and the program gathers their
+        # rows on device. Host mode (table=None): requests carry their own
+        # row, padded per batch to ``excl_width``.
+        self._excl_dev = None
+        if exclude_table is not None:
+            self._excl_dev = jnp.asarray(np.asarray(exclude_table, dtype=np.int32))
+            excl_width = int(exclude_table.shape[1])
+            self.excl_width = excl_width  # exact table width — shape-stable
+        else:
+            self.excl_width = _pow2_bucket(excl_width) if excl_width else 0
+        self.item_block = int(item_block)
+        self.max_batch = max(1, _pow2_bucket(max_batch))
+        self.window_s = float(window_ms) / 1e3
+        self.metrics = metrics
+        self._uf, self._vf = model.device_factors()
+        self._n_users = int(self._uf.shape[0])
+        self._queue: "queue.Queue[_Request | object]" = queue.Queue(maxsize=max_queue)
+        self._executables: dict[tuple[int, int, int], object] = {}
+        self._exec_lock = threading.Lock()
+        self._stop = threading.Event()
+        self._abort = threading.Event()
+        # Guards the closed-check + enqueue in submit() against stop()'s
+        # post-join drain: without it a submit could land its request AFTER
+        # the drain, leaving a future nobody resolves (the HTTP thread would
+        # hang its full result timeout). Held only for a put_nowait.
+        self._submit_lock = threading.Lock()
+        self._closed = False
+        self.batches_run = 0
+        self.requests_served = 0
+        self._worker = threading.Thread(
+            target=self._run, name="albedo-micro-batcher", daemon=True
+        )
+        self._worker.start()
+
+    # ------------------------------------------------------------- public API
+
+    @property
+    def device_exclusion(self) -> bool:
+        return self._excl_dev is not None
+
+    def submit(
+        self, dense_user: int, k: int, exclude: "np.ndarray | bool | None" = None
+    ) -> Future:
+        """Enqueue one request; resolve to ``(scores (k,), item_idx (k,))``.
+
+        ``exclude``: ``None`` scores all items; ``True`` uses the device
+        exclusion table (requires one); an int32 row of seen item indices
+        excludes host-side."""
+        if self._closed:
+            raise RuntimeError("batcher is shut down")
+        if exclude is True and self._excl_dev is None:
+            raise ValueError("exclude=True needs an exclude_table")
+        if isinstance(exclude, np.ndarray) and exclude.size > self.excl_width:
+            # Reject rather than silently truncate: a clipped exclusion row
+            # would return already-seen items and break parity with the
+            # padded_rows single-request path.
+            raise ValueError(
+                f"exclude row ({exclude.size}) wider than excl_width="
+                f"{self.excl_width}; size the batcher to the longest history"
+            )
+        if not 0 <= int(dense_user) < self._n_users:
+            raise IndexError(
+                f"user index out of range [0, {self._n_users}): {dense_user}"
+            )
+        fut: Future = Future()
+        req = _Request(int(dense_user), int(k), exclude, fut)
+        try:
+            with self._submit_lock:
+                if self._closed:
+                    raise RuntimeError("batcher is shut down")
+                self._queue.put_nowait(req)
+        except queue.Full:
+            if self.metrics is not None:
+                self.metrics.shed.inc()
+            raise QueueOverflow(
+                f"serving queue full ({self._queue.maxsize} waiting)"
+            ) from None
+        return fut
+
+    def warm(self, ks: tuple[int, ...] = (30,), with_exclusion: bool = True) -> dict:
+        """Pre-compile the full (bucket, k, exclusion) executable ladder.
+
+        Returns ``{shape_key: source}`` (``memory``/``disk``/``compile``) so
+        callers can report how much of the ladder was already cached. After
+        this, no serving request pays a trace+compile for the warmed ks.
+        """
+        modes = {"none"}
+        if with_exclusion:
+            if self._excl_dev is not None:
+                modes.add("device")
+            elif self.excl_width:
+                modes.add("host")
+        sources: dict = {}
+        k_ladder = sorted({_pow2_bucket(int(k)) for k in ks})
+        bucket = 1
+        while bucket <= self.max_batch:
+            for k in k_ladder:
+                for mode in sorted(modes):
+                    key = (bucket, k, mode)
+                    _, compile_s, source = self._executable(key)
+                    sources[key] = source
+                    if source != "memory":
+                        log.info(
+                            "warmed serving shape bucket=%d k=%d excl=%s "
+                            "(%s, %.2fs)", bucket, k, mode, source, compile_s
+                        )
+            bucket *= 2
+        return sources
+
+    def stop(self, drain: bool = True, timeout: float = 10.0) -> None:
+        """Stop the worker. ``drain=True`` finishes queued work first;
+        ``drain=False`` fails queued futures immediately."""
+        with self._submit_lock:
+            if self._closed:
+                return
+            self._closed = True
+        if not drain:
+            self._abort.set()
+        self._stop.set()
+        # Nudge the worker out of its blocking get.
+        try:
+            self._queue.put_nowait(_SENTINEL)
+        except queue.Full:
+            pass
+        self._worker.join(timeout=timeout)
+        # Anything still queued after the join window fails loudly rather
+        # than leaving HTTP threads blocked on futures nobody will resolve.
+        while True:
+            try:
+                req = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            if isinstance(req, _Request):
+                req.future.set_exception(RuntimeError("batcher shut down"))
+
+    @property
+    def mean_batch_size(self) -> float:
+        return self.requests_served / self.batches_run if self.batches_run else 0.0
+
+    # ---------------------------------------------------------------- worker
+
+    def _run(self) -> None:
+        while True:
+            try:
+                first = self._queue.get(timeout=0.05)
+            except queue.Empty:
+                if self._stop.is_set():
+                    return
+                continue
+            if first is _SENTINEL:
+                if self._stop.is_set() and self._queue.empty():
+                    return
+                continue
+            # Self-clocking collection: drain whatever is already queued (a
+            # loaded server fills batches from work that arrived during the
+            # previous execution — no artificial stall), and only when the
+            # batch would be a singleton wait up to the window for company.
+            batch = [first]
+            self._drain_into(batch)
+            if len(batch) == 1 and self.window_s > 0 and not self._stop.is_set():
+                deadline = time.monotonic() + self.window_s
+                while len(batch) == 1:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    try:
+                        nxt = self._queue.get(timeout=remaining)
+                    except queue.Empty:
+                        break
+                    if nxt is not _SENTINEL:
+                        batch.append(nxt)
+                self._drain_into(batch)
+            if self._abort.is_set():
+                for req in batch:
+                    req.future.set_exception(RuntimeError("batcher shut down"))
+                continue
+            groups: dict[tuple[int, str], list[_Request]] = {}
+            for req in batch:
+                mode = (
+                    "none" if req.exclude is None
+                    else "device" if req.exclude is True
+                    else "host"
+                )
+                groups.setdefault((_pow2_bucket(req.k), mode), []).append(req)
+            for (k_exec, mode), reqs in groups.items():
+                try:
+                    self._execute(k_exec, mode, reqs)
+                except Exception as e:  # noqa: BLE001 — fail the batch, not the worker
+                    for req in reqs:
+                        if not req.future.done():
+                            req.future.set_exception(e)
+
+    def _drain_into(self, batch: list) -> None:
+        while len(batch) < self.max_batch:
+            try:
+                nxt = self._queue.get_nowait()
+            except queue.Empty:
+                return
+            if nxt is not _SENTINEL:
+                batch.append(nxt)
+
+    def _executable(self, key: tuple[int, int, str]):
+        """(bucket, k, exclusion mode) -> compiled handle, via the AOT caches."""
+        compiled = self._executables.get(key)
+        if compiled is not None:
+            return compiled, 0.0, "memory"
+        with self._exec_lock:
+            compiled = self._executables.get(key)
+            if compiled is not None:
+                return compiled, 0.0, "memory"
+            bucket, k, mode = key
+            user_idx = np.zeros(bucket, dtype=np.int32)
+            key_parts = (
+                "serve_topk", bucket, k, mode, self.excl_width, self.item_block,
+                tuple(self._uf.shape), tuple(self._vf.shape),
+                str(self._uf.dtype), jax.default_backend(),
+            )
+            if mode == "device":
+                fn = _gather_topk_device_excl
+                args = (self._uf, self._vf, self._excl_dev, user_idx)
+            else:
+                fn = _gather_topk
+                excl = (
+                    np.full((bucket, self.excl_width), -1, dtype=np.int32)
+                    if mode == "host" else None
+                )
+                args = (self._uf, self._vf, user_idx, excl)
+            compiled, compile_s, source = persistent_aot_executable(
+                fn, args, None,
+                {"k": k, "item_block": self.item_block},
+                key_parts,
+                name="serve_topk",
+            )
+            self._executables[key] = compiled
+            return compiled, compile_s, source
+
+    def _execute(self, k: int, mode: str, reqs: list[_Request]) -> None:
+        t0 = time.perf_counter()
+        bucket = _pow2_bucket(len(reqs))
+        user_idx = np.zeros(bucket, dtype=np.int32)
+        for i, req in enumerate(reqs):
+            user_idx[i] = req.dense_user
+        compiled, _, _ = self._executable((bucket, k, mode))
+        if mode == "device":
+            vals, idx = compiled(self._uf, self._vf, self._excl_dev, user_idx)
+        else:
+            excl = None
+            if mode == "host":
+                width = self.excl_width
+                excl = np.full((bucket, width), -1, dtype=np.int32)
+                for i, req in enumerate(reqs):
+                    row = req.exclude
+                    if isinstance(row, np.ndarray) and row.size:
+                        n = min(int(row.size), width)
+                        excl[i, :n] = row[:n]
+            vals, idx = compiled(self._uf, self._vf, user_idx, excl)
+        vals, idx = np.asarray(vals), np.asarray(idx)
+        for i, req in enumerate(reqs):
+            if not req.future.done():
+                # k was quantized up for the executable; each request gets
+                # exactly its own top-k back (top-j == first j of top-K).
+                req.future.set_result((vals[i, : req.k], idx[i, : req.k]))
+        self.batches_run += 1
+        self.requests_served += len(reqs)
+        if self.metrics is not None:
+            self.metrics.batch_size.observe(len(reqs))
+            self.metrics.batch_latency.observe(time.perf_counter() - t0)

@@ -1,0 +1,263 @@
+"""HTTP plane: routes, input hardening, load shedding, graceful shutdown.
+
+Reference parity: the Django web layer — ``app/views.py`` + ``app/urls.py``
+(index page) and ``app/admin.py`` (list/search screens) — extended with the
+online engine's operational surface:
+
+  GET  /                       index page (route listing)
+  GET  /healthz                liveness probe
+  GET  /metrics                Prometheus text exposition (0.0.4)
+  GET  /recommend/<user_id>?k=30&exclude_seen=1   engine top-k
+  GET  /admin/repos?q=&limit=  repo list/search
+  GET  /admin/users?q=&limit=  user list/search
+  POST /cache/invalidate[?user_id=]               explicit cache invalidation
+
+Hardening (every rule tested in ``tests/test_serving_http.py``):
+
+- ``k``/``limit`` are clamped to sane ranges (negative, zero, and absurd
+  values used to flow straight into ``ALSModel.recommend``/``df.head``);
+  non-integer values are a 400, not a traceback.
+- ``q`` is length-capped before it reaches pandas.
+- Unexpected exceptions return a 500 **with a JSON body** — the seed's
+  handler only caught ValueError/KeyError and left the socket to die.
+- Queue overflow (``QueueOverflow``) returns 429 + ``Retry-After``.
+
+``serve()`` returns a :class:`ServerHandle`: context-manager friendly,
+idempotent ``shutdown()`` that stops accepting, joins the server thread, and
+drains the service's batcher — tests never leak threads.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+from albedo_tpu.serving.batcher import QueueOverflow
+from albedo_tpu.serving.service import RecommendationService
+
+log = logging.getLogger(__name__)
+
+MAX_LIMIT = 500
+MAX_QUERY_CHARS = 256
+
+_INDEX_HTML = """<!doctype html>
+<html><head><title>Albedo-TPU</title></head>
+<body><h1>Albedo-TPU</h1>
+<p>A github repo recommender, served from trained artifacts.</p>
+<ul>
+<li>GET /recommend/&lt;user_id&gt;?k=30&amp;exclude_seen=1</li>
+<li>GET /admin/repos?q=tensor&amp;limit=20</li>
+<li>GET /admin/users?q=vinta&amp;limit=20</li>
+<li>GET /metrics</li>
+<li>GET /healthz</li>
+<li>POST /cache/invalidate?user_id=123</li>
+</ul></body></html>"""
+
+
+class BadRequest(ValueError):
+    """Client error with a message safe to echo back."""
+
+
+def _int_param(q: dict, name: str, default: int, lo: int, hi: int) -> int:
+    """Parse + clamp an integer query param; junk is a 400, extremes clamp."""
+    raw = q.get(name, [None])[0]
+    if raw is None or raw == "":
+        return default
+    try:
+        value = int(raw)
+    except ValueError:
+        raise BadRequest(f"{name} must be an integer, got {raw!r}") from None
+    return max(lo, min(value, hi))
+
+
+def _str_param(q: dict, name: str, default: str = "") -> str:
+    return q.get(name, [default])[0][:MAX_QUERY_CHARS]
+
+
+def _make_handler(service: RecommendationService):
+    metrics = service.metrics
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *args):  # quiet by default
+            pass
+
+        def _send(self, code: int, body: bytes, ctype: str, extra: dict | None = None) -> None:
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            for k, v in (extra or {}).items():
+                self.send_header(k, v)
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _json(self, obj, code: int = 200, extra: dict | None = None) -> None:
+            self._send(code, json.dumps(obj).encode(), "application/json", extra)
+
+        _KNOWN_ROUTES = frozenset(
+            {"healthz", "metrics", "recommend", "admin", "cache"}
+        )
+
+        def _route(self) -> str:
+            """Metrics label for the request path — normalized to the known
+            route set so a URL scanner can't mint unbounded counter children
+            (label cardinality = len(_KNOWN_ROUTES) + 2, forever)."""
+            parts = [p for p in urlparse(self.path).path.split("/") if p]
+            if not parts:
+                return "index"
+            return parts[0] if parts[0] in self._KNOWN_ROUTES else "other"
+
+        def _dispatch(self, method: str) -> None:
+            t0 = time.perf_counter()
+            code = 500
+            try:
+                code = self._handle(method)
+            except BadRequest as e:
+                code = 400
+                self._json({"error": str(e)}, code=400)
+            except QueueOverflow as e:
+                # Load shedding: the bounded queue protects latency; tell the
+                # client when to come back instead of letting it hang.
+                code = 429
+                self._json({"error": str(e)}, code=429, extra={"Retry-After": "1"})
+            except BrokenPipeError:
+                code = 499  # client went away mid-response; nothing to send
+            except Exception as e:  # noqa: BLE001 — 500-with-JSON, never a hung socket
+                log.exception("unhandled error serving %s", self.path)
+                code = 500
+                try:
+                    self._json({"error": f"internal error: {type(e).__name__}"}, code=500)
+                except OSError:
+                    pass
+            finally:
+                metrics.requests.inc(route=self._route(), status=str(code))
+                metrics.request_latency.observe(time.perf_counter() - t0)
+
+        def _handle(self, method: str) -> int:
+            url = urlparse(self.path)
+            q = parse_qs(url.query)
+            parts = [p for p in url.path.split("/") if p]
+
+            if method == "POST":
+                if parts[:2] == ["cache", "invalidate"]:
+                    raw_uid = _str_param(q, "user_id", "")
+                    if raw_uid:
+                        try:
+                            uid = int(raw_uid)
+                        except ValueError:
+                            raise BadRequest(f"user_id must be an integer, got {raw_uid!r}") from None
+                        n = service.invalidate(uid)
+                    else:
+                        n = service.invalidate()
+                    self._json({"invalidated": n})
+                    return 200
+                self._json({"error": "not found"}, code=404)
+                return 404
+
+            if not parts:
+                self._send(200, _INDEX_HTML.encode(), "text/html")
+                return 200
+            if parts[0] == "healthz":
+                self._json({"ok": True})
+                return 200
+            if parts[0] == "metrics":
+                # Per-stage timings refresh at scrape time (shared Timer).
+                if service.pipeline is not None:
+                    metrics.observe_timer(service.pipeline.timer)
+                self._send(
+                    200, metrics.render().encode(),
+                    "text/plain; version=0.0.4; charset=utf-8",
+                )
+                return 200
+            if parts[0] == "recommend" and len(parts) == 2:
+                try:
+                    user_id = int(parts[1])
+                except ValueError:
+                    raise BadRequest(f"user id must be an integer, got {parts[1]!r}") from None
+                k = _int_param(q, "k", service.default_k, 1, service.max_k)
+                exclude_seen = _str_param(q, "exclude_seen", "1") != "0"
+                code, body = service.handle_recommend(user_id, k=k, exclude_seen=exclude_seen)
+                self._json(body, code=code)
+                return code
+            if parts[:2] == ["admin", "repos"]:
+                limit = _int_param(q, "limit", 20, 1, MAX_LIMIT)
+                self._json(service.search_repos(_str_param(q, "q"), limit))
+                return 200
+            if parts[:2] == ["admin", "users"]:
+                limit = _int_param(q, "limit", 20, 1, MAX_LIMIT)
+                self._json(service.search_users(_str_param(q, "q"), limit))
+                return 200
+            self._json({"error": "not found"}, code=404)
+            return 404
+
+        def do_GET(self):  # noqa: N802 — http.server API
+            self._dispatch("GET")
+
+        def do_POST(self):  # noqa: N802
+            self._dispatch("POST")
+
+    return Handler
+
+
+class ServerHandle:
+    """Running server + its thread + the service it fronts.
+
+    Drop-in for the seed's raw ``ThreadingHTTPServer`` return value
+    (``server_address``, ``shutdown()``), plus context management and a
+    drain-on-shutdown guarantee: in-flight batches finish, the batcher
+    worker and pipeline pool stop, and the server thread is joined — no
+    leaked threads between tests.
+    """
+
+    def __init__(self, server: ThreadingHTTPServer, thread: threading.Thread,
+                 service: RecommendationService):
+        self._server = server
+        self._thread = thread
+        self._service = service
+        self._down = False
+        self._lock = threading.Lock()
+
+    @property
+    def server_address(self):
+        return self._server.server_address
+
+    @property
+    def service(self) -> RecommendationService:
+        return self._service
+
+    def shutdown(self) -> None:
+        with self._lock:
+            if self._down:
+                return
+            self._down = True
+        self._server.shutdown()          # stop accepting; finish in-flight
+        self._thread.join(timeout=10.0)
+        self._server.server_close()
+        self._service.close()            # drain + stop batcher/pipeline
+
+    close = shutdown
+
+    def __enter__(self) -> "ServerHandle":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.shutdown()
+
+
+def serve(
+    service: RecommendationService, host: str = "127.0.0.1", port: int = 8080
+) -> ServerHandle:
+    """Start the server; returns a :class:`ServerHandle` (``shutdown()`` to
+    stop, or use as a context manager). Port 0 picks a free port
+    (``handle.server_address[1]``)."""
+    server = ThreadingHTTPServer((host, port), _make_handler(service))
+    # Request-handler threads must not pin the process (or tests) open.
+    server.daemon_threads = True
+    thread = threading.Thread(
+        target=server.serve_forever, name="albedo-http", daemon=True
+    )
+    thread.start()
+    return ServerHandle(server, thread, service)

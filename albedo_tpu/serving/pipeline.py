@@ -1,0 +1,261 @@
+"""Online two-stage pipeline: candidate fan-out -> LR re-rank, with deadlines.
+
+This is the paper's product loop run per-request instead of per-batch-job:
+the reference fuses ALS + curation + popularity candidates and re-ranks them
+with the trained LR model offline (``LogisticRegressionRanker.scala:368-444``),
+printing the result; here the same fusion answers HTTP requests under a
+latency budget, so every stage gets a deadline and a degradation path:
+
+- a candidate source missing its deadline (or raising) is dropped from the
+  fusion — the request still answers from the sources that made it;
+- the ranker missing its deadline (or raising, or dropping every cold pair)
+  degrades to **raw ALS scores**, then to the next stage-1 source — never a
+  500, never a hang;
+- the ALS source itself runs through the micro-batcher
+  (:class:`BatchedALSSource`), so stage-1 fan-outs from concurrent requests
+  coalesce into shared device batches.
+
+Every degraded answer is tagged in the response (``"degraded": [reasons]``)
+and counted in ``albedo_degraded_total{reason=...}``; per-stage wall-clock
+accumulates in a ``utils.profiling.Timer`` that the metrics plane exports.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from concurrent.futures import Future, ThreadPoolExecutor, TimeoutError as FutureTimeout
+
+import numpy as np
+import pandas as pd
+
+from albedo_tpu.datasets.ragged import csr_row
+from albedo_tpu.datasets.star_matrix import StarMatrix
+from albedo_tpu.recommenders.base import Recommender, fuse_candidates
+from albedo_tpu.serving.batcher import MicroBatcher
+from albedo_tpu.utils.profiling import Timer
+
+# Fusion priority: duplicates keep the FIRST source's row (reference
+# ``reduce(union).distinct`` keeps one arbitrary row; we pin the order so
+# the ALS score survives a collision with a curation/popularity row).
+SOURCE_ORDER = ("als", "curation", "content", "popularity")
+
+
+class BatchedALSSource(Recommender):
+    """Stage-1 ALS retrieval routed through the micro-batcher.
+
+    Same output contract as ``recommenders.ALSRecommender`` (rows per known
+    user, raw ids, ``source="als"``), but each user's top-k is a batcher
+    submission — concurrent pipeline requests share device batches instead
+    of serializing single-row GEMMs.
+    """
+
+    source = "als"
+
+    def __init__(
+        self,
+        batcher: MicroBatcher,
+        matrix: StarMatrix,
+        exclude_seen: bool = False,
+        timeout_s: float = 5.0,
+        **kwargs,
+    ):
+        super().__init__(**kwargs)
+        self.batcher = batcher
+        self.matrix = matrix
+        self.exclude_seen = exclude_seen
+        self.timeout_s = float(timeout_s)
+        self._indptr, self._cols, _ = matrix.csr()  # built once, not per call
+
+    def _exclude_row(self, dense_user: int) -> np.ndarray:
+        return csr_row(self._indptr, self._cols, dense_user)
+
+    def recommend_for_users(
+        self, user_ids: np.ndarray, exclude_seen: bool | None = None
+    ) -> pd.DataFrame:
+        """``exclude_seen=None`` uses the source's configured default; the
+        pipeline threads the request's flag through here."""
+        exclude_seen = self.exclude_seen if exclude_seen is None else exclude_seen
+        dense = self.matrix.users_of(np.asarray(user_ids, np.int64))
+        known = dense >= 0
+        users = np.asarray(user_ids, dtype=np.int64)[known]
+        rows = dense[known]
+        if rows.size == 0:
+            return self._frame(np.zeros(0), np.zeros(0), np.zeros(0))
+        if not exclude_seen:
+            excl = [None] * rows.size
+        elif self.batcher.device_exclusion:
+            excl = [True] * rows.size
+        else:
+            excl = [self._exclude_row(int(r)) for r in rows]
+        futs = [
+            self.batcher.submit(int(r), self.top_k, e)
+            for r, e in zip(rows, excl)
+        ]
+        deadline = time.monotonic() + self.timeout_s
+        vals = np.empty((rows.size, self.top_k), dtype=np.float32)
+        idx = np.empty((rows.size, self.top_k), dtype=np.int32)
+        for i, fut in enumerate(futs):
+            v, ix = fut.result(timeout=max(0.0, deadline - time.monotonic()))
+            vals[i], idx[i] = v, ix
+        return self._topk_frame(users, vals, idx, self.matrix.item_ids)
+
+
+@dataclasses.dataclass
+class StageDeadlines:
+    """Per-stage latency budgets (seconds)."""
+
+    candidates_s: float = 2.0
+    ranker_s: float = 0.5
+
+
+class TwoStagePipeline:
+    """Fan out stage-1 sources, fuse, re-rank; degrade instead of failing."""
+
+    def __init__(
+        self,
+        recommenders: dict[str, Recommender],
+        ranker=None,  # builders.ranker.RankerModel (score() adds `probability`)
+        deadlines: StageDeadlines | None = None,
+        metrics=None,
+        max_workers: int = 8,
+        timer: Timer | None = None,
+    ):
+        self.recommenders = dict(recommenders)
+        self.ranker = ranker
+        self.deadlines = deadlines or StageDeadlines()
+        self.metrics = metrics
+        self.timer = timer if timer is not None else Timer()
+        self._pool = ThreadPoolExecutor(
+            max_workers=max_workers, thread_name_prefix="albedo-pipeline"
+        )
+        # The ranker runs in its OWN pool: a deadline-exceeded score() keeps
+        # its thread until it finishes (threads can't be cancelled), and on
+        # the shared pool a consistently-slow ranker would zombie every
+        # worker and starve stage-1 fan-out into empty responses — exactly
+        # when the degradation path matters most.
+        self._rank_pool = ThreadPoolExecutor(
+            max_workers=max(2, max_workers // 2),
+            thread_name_prefix="albedo-ranker",
+        )
+        self._closed = False
+
+    def close(self) -> None:
+        if not self._closed:
+            self._closed = True
+            self._pool.shutdown(wait=False, cancel_futures=True)
+            self._rank_pool.shutdown(wait=False, cancel_futures=True)
+
+    def _degrade(self, degraded: list[str], reason: str) -> None:
+        degraded.append(reason)
+        if self.metrics is not None:
+            self.metrics.degraded.inc(reason=reason)
+
+    def _source_order(self) -> list[str]:
+        names = list(self.recommenders)
+        return sorted(
+            names,
+            key=lambda n: SOURCE_ORDER.index(n) if n in SOURCE_ORDER else len(SOURCE_ORDER),
+        )
+
+    def candidates(
+        self, user_id: int, degraded: list[str], exclude_seen: bool = True
+    ) -> dict[str, pd.DataFrame]:
+        """Stage 1: every registered source in parallel, one shared deadline.
+        ``exclude_seen`` reaches the sources that honor it (the ALS source);
+        popularity/curation/content don't filter by history, as in the
+        reference fusion."""
+        users = np.array([int(user_id)], dtype=np.int64)
+        futs: dict[str, Future] = {
+            name: (
+                self._pool.submit(rec.recommend_for_users, users, exclude_seen)
+                if isinstance(rec, BatchedALSSource)
+                else self._pool.submit(rec.recommend_for_users, users)
+            )
+            for name, rec in self.recommenders.items()
+        }
+        deadline = time.monotonic() + self.deadlines.candidates_s
+        frames: dict[str, pd.DataFrame] = {}
+        for name, fut in futs.items():
+            try:
+                frames[name] = fut.result(timeout=max(0.0, deadline - time.monotonic()))
+            except FutureTimeout:
+                fut.cancel()
+                self._degrade(degraded, f"candidate_timeout_{name}")
+            except Exception:  # noqa: BLE001 — a broken source degrades, never 500s
+                self._degrade(degraded, f"candidate_error_{name}")
+        return frames
+
+    def _rank(self, candidates: pd.DataFrame) -> pd.DataFrame:
+        return self.ranker.score(candidates)
+
+    def recommend(self, user_id: int, k: int, exclude_seen: bool = True) -> dict:
+        """One online request: returns ``{stage, degraded, items}`` where each
+        item is ``{repo_id, score, source}`` (score = LR probability on the
+        full two-stage path, raw stage-1 score on degraded paths)."""
+        degraded: list[str] = []
+        timer_section = self.timer.section
+        with timer_section("stage1_candidates"):
+            frames = self.candidates(user_id, degraded, exclude_seen=exclude_seen)
+
+        order = [n for n in self._source_order() if n in frames and len(frames[n])]
+        if not order:
+            return {"stage": "empty", "degraded": degraded, "items": []}
+        fused = fuse_candidates([frames[n] for n in order])
+
+        ranked = None
+        if self.ranker is not None:
+            fut = self._rank_pool.submit(self._rank, fused)
+            try:
+                with timer_section("stage2_rank"):
+                    ranked = fut.result(timeout=self.deadlines.ranker_s)
+            except FutureTimeout:
+                fut.cancel()
+                ranked = None
+                self._degrade(degraded, "ranker_timeout")
+            except Exception:  # noqa: BLE001
+                ranked = None
+                self._degrade(degraded, "ranker_error")
+            if ranked is not None and not len(ranked):
+                # coldStartStrategy="drop" can drop EVERY candidate pair for
+                # a user the factorization never saw — raw scores still serve.
+                ranked = None
+                self._degrade(degraded, "ranker_empty")
+
+        if ranked is not None:
+            out = ranked.sort_values("probability", ascending=False, kind="stable").head(k)
+            items = [
+                {
+                    "repo_id": int(r.repo_id),
+                    "score": float(r.probability),
+                    "source": str(getattr(r, "source", "")),
+                }
+                for r in out.itertuples()
+            ]
+            stage = "two_stage"
+        else:
+            # Degraded ordering: raw ALS scores first, then the remaining
+            # sources in priority order (curation -> content -> popularity).
+            # Dedup DURING accumulation, so overlap with an earlier source
+            # never leaves the response short while later sources go unused.
+            items = []
+            seen: set[int] = set()
+            for name in order:
+                if len(items) >= k:
+                    break
+                f = frames[name].sort_values("score", ascending=False, kind="stable")
+                for r in f.itertuples():
+                    repo_id = int(r.repo_id)
+                    if repo_id in seen:
+                        continue
+                    seen.add(repo_id)
+                    items.append(
+                        {"repo_id": repo_id, "score": float(r.score), "source": name}
+                    )
+                    if len(items) >= k:
+                        break
+            stage = f"stage1_{order[0]}"
+
+        # Stage gauges are refreshed from self.timer at /metrics scrape time
+        # (http.py) — no per-request mirroring on the hot path.
+        return {"stage": stage, "degraded": degraded, "items": items}

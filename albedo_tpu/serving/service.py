@@ -1,0 +1,360 @@
+"""Artifact-backed serving engine: batcher + two-stage pipeline + cache.
+
+Promoted from the seed's single-module serving layer (Django ``views/admin``
+parity): :class:`RecommendationService` still answers id-mapped top-k and
+admin search from trained artifacts, but requests now flow through the
+online engine:
+
+1. **TTL result cache** (``serving.cache``) — hot users skip the device.
+2. **Two-stage pipeline** (``serving.pipeline``) when candidate sources are
+   registered: fan-out -> fuse -> LR re-rank with per-stage deadlines and
+   graceful degradation.
+3. **Micro-batcher** (``serving.batcher``) — all ALS scoring, both the plain
+   ``/recommend`` path and the pipeline's stage-1 source, coalesces into
+   fixed-shape device batches. ``batching=False`` keeps the seed's direct
+   single-request path (the parity baseline).
+4. **Metrics** (``serving.metrics``) — every outcome is counted; the HTTP
+   layer renders the registry at ``/metrics``.
+
+Degradation contract (tested): ranker deadline exceeded -> raw ALS scores;
+missing/cold ALS artifacts (``model=None``) -> popularity fallback; queue
+overflow -> :class:`~albedo_tpu.serving.batcher.QueueOverflow` (HTTP 429).
+Every degraded response carries ``"degraded": [reasons]`` and bumps
+``albedo_degraded_total{reason=...}``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+
+import numpy as np
+import pandas as pd
+
+from albedo_tpu.datasets.ragged import csr_row, padded_rows
+from albedo_tpu.datasets.star_matrix import StarMatrix
+from albedo_tpu.models.als import ALSModel
+from albedo_tpu.serving.batcher import MicroBatcher
+from albedo_tpu.serving.cache import TTLCache
+from albedo_tpu.serving.metrics import MetricsRegistry
+from albedo_tpu.serving.pipeline import (
+    BatchedALSSource,
+    StageDeadlines,
+    TwoStagePipeline,
+)
+
+
+class RecommendationService:
+    """Read-only online engine over trained artifacts.
+
+    Seed-compatible construction (``RecommendationService(model, matrix,
+    repo_info, user_info)``) serves the plain ALS path; the engine features
+    are opt-in keywords. ``model=None`` declares the ALS artifacts missing —
+    the service stays up and answers from the ``popularity`` source (the
+    cold-artifact degradation path).
+    """
+
+    def __init__(
+        self,
+        model: ALSModel | None,
+        matrix: StarMatrix | None,
+        repo_info: pd.DataFrame | None = None,
+        user_info: pd.DataFrame | None = None,
+        *,
+        recommenders: dict | None = None,
+        ranker=None,
+        metrics: MetricsRegistry | None = None,
+        batching: bool = True,
+        batch_window_ms: float = 2.0,
+        max_batch: int = 64,
+        max_queue: int = 256,
+        cache_ttl: float = 0.0,
+        cache_size: int = 4096,
+        deadlines: StageDeadlines | None = None,
+        default_k: int = 30,
+        max_k: int = 500,
+        item_block: int = 4096,
+        warm: bool = False,
+    ):
+        self.model = model
+        self.matrix = matrix
+        self.repo_info = repo_info if repo_info is not None else pd.DataFrame()
+        self.user_info = user_info if user_info is not None else pd.DataFrame()
+        self.metrics = metrics if metrics is not None else MetricsRegistry()
+        self.default_k = int(default_k)
+        self.max_k = int(max_k)
+        self.item_block = int(item_block)
+        self._closed = False
+        self._close_lock = threading.Lock()
+
+        if matrix is not None:
+            self._indptr, self._cols, _ = matrix.csr()
+            max_hist = int((self._indptr[1:] - self._indptr[:-1]).max()) if matrix.n_users else 0
+        else:
+            self._indptr = self._cols = None
+            max_hist = 0
+        self._repo_names = (
+            self.repo_info.set_index("repo_id")["repo_full_name"].to_dict()
+            if "repo_full_name" in self.repo_info.columns
+            else {}
+        )
+
+        self.batcher: MicroBatcher | None = None
+        if batching and model is not None:
+            # Device-side exclusion table: the users' seen-item rows,
+            # -1-padded, uploaded once — requests then exclude by a device
+            # gather instead of per-request host slicing. Skewed datasets
+            # (one power user -> huge padded width) fall back to host rows;
+            # the cap is entries, i.e. 4 bytes each.
+            exclude_table = None
+            if matrix is not None and max_hist:
+                cap = int(os.environ.get("ALBEDO_SERVE_EXCL_TABLE_MAX", str(32 << 20)))
+                if matrix.n_users * max_hist <= cap:
+                    exclude_table = padded_rows(
+                        self._indptr, self._cols, np.arange(matrix.n_users)
+                    )
+            self.batcher = MicroBatcher(
+                model,
+                exclude_table=exclude_table,
+                excl_width=max_hist,
+                item_block=item_block,
+                max_batch=max_batch,
+                max_queue=max_queue,
+                window_ms=batch_window_ms,
+                metrics=self.metrics,
+            )
+            if warm:
+                self.batcher.warm(ks=(self.default_k,))
+
+        self.cache: TTLCache | None = (
+            TTLCache(maxsize=cache_size, ttl=cache_ttl) if cache_ttl > 0 else None
+        )
+
+        self.pipeline: TwoStagePipeline | None = None
+        if recommenders:
+            sources = dict(recommenders)
+            if model is not None and matrix is not None and "als" not in sources:
+                if self.batcher is not None:
+                    sources["als"] = BatchedALSSource(
+                        self.batcher, matrix, exclude_seen=True, top_k=self.default_k
+                    )
+                else:
+                    from albedo_tpu.recommenders import ALSRecommender
+
+                    sources["als"] = ALSRecommender(
+                        model, matrix, exclude_seen=True, top_k=self.default_k
+                    )
+            self.pipeline = TwoStagePipeline(
+                sources, ranker=ranker, deadlines=deadlines, metrics=self.metrics
+            )
+
+    # ----------------------------------------------------------- lifecycle
+
+    def close(self) -> None:
+        """Stop the batcher (draining in-flight work) and the pipeline pool.
+        Idempotent; the HTTP layer calls it from ``ServerHandle.shutdown``."""
+        with self._close_lock:
+            if self._closed:
+                return
+            self._closed = True
+        if self.batcher is not None:
+            self.batcher.stop(drain=True)
+        if self.pipeline is not None:
+            self.pipeline.close()
+
+    def __enter__(self) -> "RecommendationService":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # ----------------------------------------------------------- helpers
+
+    def clamp_k(self, k) -> int:
+        """Harden ``k``: junk/absurd values become sane bounds, never an
+        index error deep inside the model."""
+        try:
+            k = int(k)
+        except (TypeError, ValueError):
+            return self.default_k
+        return max(1, min(k, self.max_k))
+
+    def _named_items(self, repo_ids, scores, sources=None) -> list[dict]:
+        items = []
+        for i, (repo_id, score) in enumerate(zip(repo_ids, scores)):
+            item = {
+                "repo_id": int(repo_id),
+                "repo_full_name": self._repo_names.get(int(repo_id)),
+                "score": float(score),
+            }
+            if sources is not None:
+                item["source"] = sources[i]
+            items.append(item)
+        return items
+
+    def _exclude_row(self, dense_user: int) -> np.ndarray:
+        return csr_row(self._indptr, self._cols, dense_user)
+
+    def invalidate(self, user_id: int | None = None) -> int:
+        """Explicit cache invalidation (e.g. after a star ingest)."""
+        if self.cache is None:
+            return 0
+        if user_id is None:
+            return self.cache.invalidate_all()
+        return self.cache.invalidate_user(int(user_id))
+
+    # ------------------------------------------------------- request paths
+
+    def recommend(self, user_id: int, k: int = 30, exclude_seen: bool = True) -> dict:
+        """The seed's direct single-request path: one blocking GEMM + top-k.
+
+        Kept verbatim as the parity baseline for the micro-batcher (and the
+        ``batching=False`` serving mode)."""
+        dense = self.matrix.users_of(np.array([user_id], dtype=np.int64))
+        if dense[0] < 0:
+            return {"user_id": user_id, "error": "unknown user", "items": []}
+        excl = padded_rows(self._indptr, self._cols, dense) if exclude_seen else None
+        vals, idx = self.model.recommend(
+            dense, k=k, exclude_idx=excl, item_block=self.item_block
+        )
+        ok = (idx[0] >= 0) & np.isfinite(vals[0])
+        repo_ids = self.matrix.item_ids[idx[0][ok]]
+        return {
+            "user_id": user_id,
+            "k": k,
+            "items": self._named_items(repo_ids, vals[0][ok]),
+        }
+
+    def _recommend_batched(self, user_id: int, k: int, exclude_seen: bool) -> dict:
+        dense = self.matrix.users_of(np.array([user_id], dtype=np.int64))
+        if dense[0] < 0:
+            return {"user_id": user_id, "error": "unknown user", "items": []}
+        exclude = None
+        if exclude_seen:
+            exclude = (
+                True if self.batcher.device_exclusion
+                else self._exclude_row(int(dense[0]))
+            )
+        fut = self.batcher.submit(int(dense[0]), k, exclude)
+        vals, idx = fut.result(timeout=30.0)
+        ok = (idx >= 0) & np.isfinite(vals)
+        repo_ids = self.matrix.item_ids[idx[ok]]
+        return {
+            "user_id": user_id,
+            "k": k,
+            "items": self._named_items(repo_ids, vals[ok]),
+        }
+
+    def handle_recommend(
+        self, user_id: int, k=None, exclude_seen: bool = True
+    ) -> tuple[int, dict]:
+        """Full engine path: cache -> (two-stage | batched ALS | fallback).
+
+        Returns ``(http_status, body)``; raises
+        :class:`~albedo_tpu.serving.batcher.QueueOverflow` for the HTTP
+        layer's 429. Never returns a half-built body: every path ends in a
+        well-formed dict.
+        """
+        user_id = int(user_id)
+        k = self.clamp_k(k if k is not None else self.default_k)
+        if self.pipeline is not None:
+            # Two-stage k is bounded by the stage-1 candidate budget (each
+            # source generates default_k candidates, the reference's top-30
+            # product shape) — clamp and SAY so, rather than claiming a k
+            # the fusion cannot fill.
+            k = min(k, self.default_k)
+        key = ("rec", user_id, k, bool(exclude_seen), self.pipeline is not None)
+        if self.cache is not None:
+            hit = self.cache.get(key)
+            if hit is not None:
+                self.metrics.cache_hits.inc()
+                return hit
+            self.metrics.cache_misses.inc()
+
+        status, body = self._compute(user_id, k, exclude_seen)
+        if self.cache is not None and status == 200 and not body.get("degraded"):
+            self.cache.put(key, (status, body), user_id=user_id)
+        return status, body
+
+    def _compute(self, user_id: int, k: int, exclude_seen: bool) -> tuple[int, dict]:
+        # Cold/missing ALS artifacts: the popularity fallback keeps answering.
+        # The degraded counter counts ANSWERED degraded requests only — the
+        # no-fallback 503 below is an error, not a degradation.
+        if self.model is None:
+            # Any registered sources (popularity and friends) live in the
+            # pipeline — a recommenders dict always constructs one, so the
+            # pipeline IS the fallback plane. Degraded counts answered
+            # requests only; the no-source 503 is an error, not degradation.
+            if self.pipeline is None:
+                return 503, {
+                    "user_id": user_id,
+                    "error": "no model loaded and no fallback source",
+                    "items": [],
+                }
+            self.metrics.degraded.inc(reason="cold_artifacts")
+            out = self.pipeline.recommend(user_id, k, exclude_seen=exclude_seen)
+            out.setdefault("degraded", []).insert(0, "cold_artifacts")
+            return 200, self._pipeline_body(user_id, k, out)
+
+        if self.pipeline is not None:
+            out = self.pipeline.recommend(user_id, k, exclude_seen=exclude_seen)
+            return 200, self._pipeline_body(user_id, k, out)
+
+        if self.batcher is not None:
+            body = self._recommend_batched(user_id, k, exclude_seen)
+        else:
+            body = self.recommend(user_id, k=k, exclude_seen=exclude_seen)
+        return (404 if body.get("error") else 200), body
+
+    def _pipeline_body(self, user_id: int, k: int, out: dict) -> dict:
+        items = out.get("items", [])
+        return {
+            "user_id": user_id,
+            "k": k,
+            "stage": out.get("stage"),
+            "degraded": out.get("degraded", []),
+            "items": [
+                {**item, "repo_full_name": self._repo_names.get(item["repo_id"])}
+                for item in items
+            ],
+        }
+
+    # -------------------------------------------------------- admin search
+
+    def search_repos(self, q: str = "", limit: int = 20) -> list[dict]:
+        """RepoInfoAdmin parity: search full_name/description, list language +
+        stars + description (``app/admin.py:19-21``)."""
+        df = self.repo_info
+        if df.empty:
+            return []
+        if q:
+            mask = df["repo_full_name"].fillna("").str.contains(q, case=False, regex=False)
+            if "repo_description" in df.columns:
+                mask |= df["repo_description"].fillna("").str.contains(q, case=False, regex=False)
+            df = df[mask]
+        cols = [
+            c for c in ("repo_id", "repo_full_name", "repo_language",
+                        "repo_stargazers_count", "repo_description")
+            if c in df.columns
+        ]
+        return json.loads(df[cols].head(limit).to_json(orient="records"))
+
+    def search_users(self, q: str = "", limit: int = 20) -> list[dict]:
+        """UserInfoAdmin parity: search login/name/company, list name/company/
+        location/bio (``app/admin.py:11-13``)."""
+        df = self.user_info
+        if df.empty:
+            return []
+        if q:
+            mask = pd.Series(False, index=df.index)
+            for col in ("user_login", "user_name", "user_company"):
+                if col in df.columns:
+                    mask |= df[col].fillna("").str.contains(q, case=False, regex=False)
+            df = df[mask]
+        cols = [
+            c for c in ("user_id", "user_login", "user_name", "user_company",
+                        "user_location", "user_bio")
+            if c in df.columns
+        ]
+        return json.loads(df[cols].head(limit).to_json(orient="records"))

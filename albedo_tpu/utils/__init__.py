@@ -1,6 +1,14 @@
 """Utility layer: profiling/timing harness and schema assertions."""
 
-from albedo_tpu.utils.checkpoint import (
+
+def pow2_at_least(n: int) -> int:
+    """Smallest power of two >= n (and >= 1) — the shape-ladder rounding
+    shared by the feature assembler's bag pads and the serving batcher's
+    user-bucket/k quantization."""
+    return 1 << max(0, int(n - 1).bit_length())
+
+
+from albedo_tpu.utils.checkpoint import (  # noqa: E402
     StepCheckpointer,
     checkpointed_als_fit,
     restore_pytree,
@@ -12,6 +20,7 @@ from albedo_tpu.utils.schema import assert_columns, equals_ignore_nullability
 __all__ = [
     "StepCheckpointer",
     "Timer",
+    "pow2_at_least",
     "assert_columns",
     "checkpointed_als_fit",
     "equals_ignore_nullability",

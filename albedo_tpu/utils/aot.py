@@ -128,6 +128,28 @@ def persistent_aot_call(
     (shapes, dtypes, statics, mesh, backend): a stale key would replay the
     wrong program.
     """
+    compiled, compile_s, source = persistent_aot_executable(
+        jitted, args, dyn_kwargs, static_kwargs, key_parts, name=name
+    )
+    return compiled(*args, **(dyn_kwargs or {})), compile_s, source
+
+
+def persistent_aot_executable(
+    jitted: Any,
+    args: tuple,
+    dyn_kwargs: dict | None,
+    static_kwargs: dict | None,
+    key_parts: tuple,
+    name: str = "fn",
+) -> tuple[Any, float, str]:
+    """Resolve the cached executable WITHOUT calling it.
+
+    Same contract and cache layers as :func:`persistent_aot_call`, but the
+    returned ``compiled`` handle is the product: long-lived callers (the
+    serving micro-batcher pre-warming one executable per batch bucket) hold
+    it and invoke ``compiled(*args, **dyn_kwargs)`` directly per request,
+    skipping the digest + LRU lookup on the hot path entirely.
+    """
     import jax
 
     dyn_kwargs = dict(dyn_kwargs or {})
@@ -137,7 +159,7 @@ def persistent_aot_call(
 
     compiled = _EXECUTABLES.get(mem_key)
     if compiled is not None:
-        return compiled(*args, **dyn_kwargs), 0.0, "memory"
+        return compiled, 0.0, "memory"
 
     source = "compile"
     compiled = None
@@ -194,4 +216,4 @@ def persistent_aot_call(
     compile_s = time.perf_counter() - t0
 
     _EXECUTABLES.put(mem_key, compiled)
-    return compiled(*args, **dyn_kwargs), compile_s, source
+    return compiled, compile_s, source

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import contextlib
 import functools
+import threading
 import time
 from typing import Any, Callable, Iterator
 
@@ -38,6 +39,11 @@ class Timer:
     def __init__(self) -> None:
         self.totals: dict[str, float] = {}
         self.counts: dict[str, int] = {}
+        # The serving pipeline accumulates sections from concurrent HTTP
+        # threads; the read-modify-write below would lose increments
+        # unlocked. Uncontended acquisition is ~100 ns — noise against the
+        # device work the sections time.
+        self._lock = threading.Lock()
 
     @contextlib.contextmanager
     def section(self, name: str, sync: Any = None) -> Iterator[None]:
@@ -47,8 +53,20 @@ class Timer:
         finally:
             _sync(sync)
             dt = time.perf_counter() - t0
-            self.totals[name] = self.totals.get(name, 0.0) + dt
-            self.counts[name] = self.counts.get(name, 0) + 1
+            with self._lock:
+                self.totals[name] = self.totals.get(name, 0.0) + dt
+                self.counts[name] = self.counts.get(name, 0) + 1
+
+    def snapshot(self) -> dict[str, dict]:
+        """Point-in-time copy of the accumulated sections:
+        ``{"totals": {name: seconds}, "counts": {name: calls}}``.
+
+        This is the one exchange format between the offline fit reports and
+        the online `/metrics` plane (``serving.metrics.MetricsRegistry
+        .observe_timer``) — both render the same dicts, so a stage timed here
+        can never read differently in the two places."""
+        with self._lock:
+            return {"totals": dict(self.totals), "counts": dict(self.counts)}
 
     def report(self, printer: Callable[[str], None] = print) -> dict[str, float]:
         for name in sorted(self.totals, key=self.totals.get, reverse=True):  # type: ignore[arg-type]

@@ -939,10 +939,24 @@ def main() -> None:
             except Exception as e:  # noqa: BLE001
                 ranker_error = (ranker_error or "") + f" w2v_refscale: {e!r}"[-300:]
 
+    # The online-engine record (micro-batched vs per-request serving). Its
+    # failure — including the parity gate's sys.exit — must not discard the
+    # training headline; it lands in serving_error instead.
+    serving_error = None
+    if os.environ.get("ALBEDO_BENCH_SERVING", "1") != "0":
+        try:
+            print(json.dumps(serving_bench()), flush=True)
+        except (Exception, SystemExit) as e:  # noqa: BLE001
+            serving_error = repr(e)[-300:]
+
     if FLAGSHIP_RECORD is not None:
         final = dict(FLAGSHIP_RECORD)
         final["ranker_error"] = ranker_error
-        final["status"] = "complete" if ranker_error is None else "partial"
+        final["serving_error"] = serving_error
+        final["status"] = (
+            "complete" if ranker_error is None and serving_error is None
+            else "partial"
+        )
     else:
         final = als_record(train_s, ndcg, info, flop, mfu, peak_source,
                            gemm_f32, gemm_bf16, hbm_gbps, dispatch_s, phases,
@@ -1008,5 +1022,206 @@ def als_record(train_s, ndcg, info, flop, mfu, peak_source,
     }
 
 
+def serving_bench() -> dict:
+    """The `serving` scenario: online-engine throughput under concurrent load.
+
+    Two engines over the SAME trained artifacts answer the same concurrent
+    request mix on CPU:
+
+    - **per_request**: the seed's serving path — one blocking GEMM + top-k
+      dispatch per request (``batching=False``).
+    - **micro_batched**: the online engine — requests coalesce into padded
+      power-of-two device batches behind pre-warmed executables.
+
+    Correctness is asserted (batched items byte-identical to the
+    per-request path for a sample mix) BEFORE timing, then both engines
+    serve ``concurrency`` closed-loop client threads for ``duration_s``.
+    The record carries sustained req/s, measured (not bucketed) latency
+    percentiles, and the realized mean batch size. Run via
+    ``python bench.py serving`` (env knobs: ALBEDO_SERVE_USERS/ITEMS/
+    CONCURRENCY/DURATION/K).
+    """
+    import statistics
+    import threading as _threading
+
+    from albedo_tpu.datasets import synthetic_tables
+    from albedo_tpu.models.als import ImplicitALS
+    from albedo_tpu.serving import RecommendationService
+
+    n_users = int(os.environ.get("ALBEDO_SERVE_USERS", "4000"))
+    n_items = int(os.environ.get("ALBEDO_SERVE_ITEMS", "3000"))
+    # 64 closed-loop clients: enough offered load that batches actually form
+    # (the per-request baseline genuinely collapses here — that contention
+    # is the phenomenon the micro-batcher exists for, not an artifact).
+    concurrency = int(os.environ.get("ALBEDO_SERVE_CONCURRENCY", "64"))
+    duration_s = float(os.environ.get("ALBEDO_SERVE_DURATION", "3"))
+    trials = int(os.environ.get("ALBEDO_SERVE_TRIALS", "3"))
+    k = int(os.environ.get("ALBEDO_SERVE_K", "30"))
+    # mean_stars drives the number of DISTINCT exclusion widths, i.e. how
+    # many per-request-path executables the warmup must compile. Keep it
+    # modest so warmup doesn't dwarf the measurement (and, on CPU-credit
+    # boxes, drain the quota the timed phases then starve under).
+    mean_stars = float(os.environ.get("ALBEDO_SERVE_MEAN_STARS", "8"))
+
+    tables = synthetic_tables(
+        n_users=n_users, n_items=n_items, mean_stars=mean_stars, seed=42
+    )
+    matrix = tables.star_matrix()
+    model = ImplicitALS(rank=16, max_iter=3, seed=0).fit(matrix)
+    user_ids = matrix.user_ids
+
+    def run_load(service, tag: str) -> dict:
+        """Closed-loop load: each client thread issues its next request the
+        moment the previous one answers. Any non-200 or exception fails the
+        bench — a silently-dead client would thin the load and publish
+        clean-looking numbers at the wrong concurrency."""
+        latencies: list[float] = []
+        lat_lock = _threading.Lock()
+        stop = _threading.Event()
+        counts = [0] * concurrency
+        errors: list[str] = []
+
+        def client(ci: int) -> None:
+            rng = np.random.default_rng(1000 + ci)
+            local: list[float] = []
+            try:
+                while not stop.is_set():
+                    uid = int(user_ids[int(rng.integers(0, len(user_ids)))])
+                    t0 = time.perf_counter()
+                    try:
+                        status, _body = service.handle_recommend(uid, k=k)
+                    except Exception as e:  # noqa: BLE001
+                        errors.append(f"{tag}: {e!r}")
+                        return
+                    local.append(time.perf_counter() - t0)
+                    if status != 200:
+                        errors.append(f"{tag}: unexpected status {status}")
+                        return
+                    counts[ci] += 1
+            finally:
+                with lat_lock:
+                    latencies.extend(local)
+
+        threads = [
+            _threading.Thread(target=client, args=(ci,), daemon=True)
+            for ci in range(concurrency)
+        ]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        time.sleep(duration_s)
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+        elapsed = time.perf_counter() - t0
+        if errors:
+            fail("serving_load", f"{len(errors)} client error(s); first: {errors[0]}")
+        lat_ms = sorted(x * 1e3 for x in latencies)
+
+        def pct(p: float) -> float:
+            if not lat_ms:
+                return 0.0
+            return lat_ms[min(len(lat_ms) - 1, int(p * len(lat_ms)))]
+
+        return {
+            "requests": sum(counts),
+            "rps": round(sum(counts) / elapsed, 1),
+            "p50_ms": round(pct(0.50), 3),
+            "p99_ms": round(pct(0.99), 3),
+            "mean_ms": round(statistics.fmean(lat_ms), 3) if lat_ms else 0.0,
+        }
+
+    record: dict = {
+        "metric": "serving_throughput_concurrent",
+        "unit": "req/s",
+        "concurrency": concurrency,
+        "duration_s": duration_s,
+        "k": k,
+        "n_users": n_users,
+        "n_items": n_items,
+        "rank": model.rank,
+    }
+
+    with RecommendationService(model, matrix, batching=False) as per_request, \
+         RecommendationService(model, matrix, batching=True, warm=True) as batched:
+        # Correctness gate first: the batched engine must reproduce the
+        # per-request path exactly on a random request mix.
+        rng = np.random.default_rng(7)
+        checked = 0
+        for uid in rng.choice(user_ids, size=32, replace=False):
+            kk = int(rng.choice([5, k]))
+            base = per_request.recommend(int(uid), k=kk)
+            _, got = batched.handle_recommend(int(uid), k=kk)
+            if [(i["repo_id"], i["score"]) for i in base["items"]] != [
+                (i["repo_id"], i["score"]) for i in got["items"]
+            ]:
+                fail("serving_parity", f"batched != per-request for user {uid}")
+            checked += 1
+        record["parity_checked_requests"] = checked
+
+        # Warm BOTH engines before timing so the record is steady-state
+        # sustained throughput, not compile amortization: the per-request
+        # path retraces per distinct exclusion width (a real seed-path cost,
+        # but a long-lived server eventually has every width compiled), the
+        # batched path pre-warmed its shape ladder above.
+        t0 = time.perf_counter()
+        indptr, _, _ = matrix.csr()
+        lens = indptr[1:] - indptr[:-1]
+        _, first_user_per_width = np.unique(lens, return_index=True)
+        for uid in user_ids[first_user_per_width]:
+            per_request.handle_recommend(int(uid), k=k)
+            batched.handle_recommend(int(uid), k=k)
+        record["warmup_s"] = round(time.perf_counter() - t0, 3)
+        record["warmup_widths"] = int(first_user_per_width.size)
+
+        # Interleaved A/B trials, median-reported: a shared/throttled CPU
+        # (cgroup quota, noisy neighbors) hits both engines equally instead
+        # of whichever phase runs last.
+        per_trials, bat_trials = [], []
+        for _ in range(max(1, trials)):
+            per_trials.append(run_load(per_request, "per_request"))
+            bat_trials.append(run_load(batched, "micro_batched"))
+        per = sorted(per_trials, key=lambda r: r["rps"])[len(per_trials) // 2]
+        bat = sorted(bat_trials, key=lambda r: r["rps"])[len(bat_trials) // 2]
+        record["mean_batch_size"] = round(batched.batcher.mean_batch_size, 2)
+        record["batches_run"] = batched.batcher.batches_run
+        record["trials"] = {
+            "per_request_rps": [r["rps"] for r in per_trials],
+            "micro_batched_rps": [r["rps"] for r in bat_trials],
+        }
+
+    record["value"] = bat["rps"]
+    record["per_request"] = per
+    record["micro_batched"] = bat
+    record["speedup_vs_per_request"] = round(
+        bat["rps"] / max(per["rps"], 1e-9), 2
+    )
+    return record
+
+
+SCENARIOS = {"serving": serving_bench}
+
+
 if __name__ == "__main__":
-    main()
+    scenario = (
+        sys.argv[1] if len(sys.argv) > 1 else os.environ.get("ALBEDO_BENCH_SCENARIO", "")
+    )
+    if scenario and scenario in SCENARIOS:
+        plat = os.environ.get("ALBEDO_BENCH_PLATFORM")
+        if plat:
+            import jax
+
+            jax.config.update("jax_platforms", plat)
+        try:
+            print(json.dumps(SCENARIOS[scenario]()), flush=True)
+        except SystemExit:
+            raise
+        except Exception as e:  # noqa: BLE001
+            print(json.dumps({"error": repr(e)[-500:], "stage": scenario}), flush=True)
+            sys.exit(1)
+    elif scenario:
+        print(json.dumps({"error": f"unknown scenario {scenario!r}",
+                          "known": sorted(SCENARIOS)}), flush=True)
+        sys.exit(2)
+    else:
+        main()

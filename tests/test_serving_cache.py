@@ -1,0 +1,108 @@
+"""TTL result cache: expiry, LRU capacity, explicit invalidation, and the
+engine's hit/miss accounting."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from albedo_tpu.datasets import synthetic_tables  # noqa: E402
+from albedo_tpu.models.als import ImplicitALS  # noqa: E402
+from albedo_tpu.serving import RecommendationService, TTLCache  # noqa: E402
+
+
+class FakeClock:
+    def __init__(self):
+        self.now = 0.0
+
+    def __call__(self) -> float:
+        return self.now
+
+
+def test_ttl_expiry_semantics():
+    clock = FakeClock()
+    cache = TTLCache(maxsize=10, ttl=5.0, clock=clock)
+    cache.put("a", 1, user_id=7)
+    assert cache.get("a") == 1
+    clock.now = 4.999
+    assert cache.get("a") == 1
+    clock.now = 5.0  # expires AT ttl (>=), measured from write
+    assert cache.get("a") is None
+    # Re-put restarts the clock from the write, not first insertion.
+    cache.put("a", 2)
+    clock.now = 9.0
+    assert cache.get("a") == 2
+
+
+def test_reads_do_not_refresh_ttl():
+    clock = FakeClock()
+    cache = TTLCache(maxsize=10, ttl=5.0, clock=clock)
+    cache.put("a", 1)
+    clock.now = 4.0
+    assert cache.get("a") == 1  # read at t=4
+    clock.now = 5.5
+    assert cache.get("a") is None  # still expired at write+5
+
+
+def test_lru_capacity_eviction():
+    cache = TTLCache(maxsize=3, ttl=100.0, clock=FakeClock())
+    for i in range(3):
+        cache.put(i, i)
+    cache.get(0)  # 0 is now most recent
+    cache.put(3, 3)  # evicts 1 (least recently used)
+    assert cache.get(1) is None
+    assert cache.get(0) == 0 and cache.get(2) == 2 and cache.get(3) == 3
+
+
+def test_explicit_invalidation():
+    cache = TTLCache(maxsize=10, ttl=100.0, clock=FakeClock())
+    cache.put(("rec", 1, 5), "a", user_id=1)
+    cache.put(("rec", 1, 10), "b", user_id=1)
+    cache.put(("rec", 2, 5), "c", user_id=2)
+    assert cache.invalidate_user(1) == 2
+    assert cache.get(("rec", 1, 5)) is None
+    assert cache.get(("rec", 2, 5)) == "c"
+    assert cache.invalidate_all() == 1
+    assert len(cache) == 0
+
+
+def test_len_counts_live_entries_only():
+    clock = FakeClock()
+    cache = TTLCache(maxsize=10, ttl=5.0, clock=clock)
+    cache.put("a", 1)
+    clock.now = 2.0
+    cache.put("b", 2)
+    assert len(cache) == 2
+    clock.now = 6.0  # "a" expired, "b" alive until 7
+    assert len(cache) == 1
+
+
+@pytest.fixture(scope="module")
+def service():
+    tables = synthetic_tables(n_users=80, n_items=50, mean_stars=6, seed=11)
+    matrix = tables.star_matrix()
+    model = ImplicitALS(rank=8, max_iter=2, seed=0).fit(matrix)
+    with RecommendationService(model, matrix, cache_ttl=60.0) as svc:
+        yield svc, matrix
+
+
+def test_engine_cache_hits_and_metrics(service):
+    svc, matrix = service
+    uid = int(matrix.user_ids[0])
+    s1, b1 = svc.handle_recommend(uid, k=5)
+    s2, b2 = svc.handle_recommend(uid, k=5)
+    assert (s1, b1) == (s2, b2)
+    assert svc.metrics.cache_hits.value() >= 1
+    assert svc.metrics.cache_misses.value() >= 1
+    # Distinct k is a distinct cache entry, not a hit.
+    hits_before = svc.metrics.cache_hits.value()
+    svc.handle_recommend(uid, k=7)
+    assert svc.metrics.cache_hits.value() == hits_before
+    # Explicit invalidation forces a recompute (identical artifacts ->
+    # identical result, but counted as a miss).
+    misses_before = svc.metrics.cache_misses.value()
+    assert svc.invalidate(uid) >= 1
+    s3, b3 = svc.handle_recommend(uid, k=5)
+    assert (s3, b3) == (s1, b1)
+    assert svc.metrics.cache_misses.value() == misses_before + 1
+    assert 0.0 < svc.metrics.cache_hit_rate() < 1.0

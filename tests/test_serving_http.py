@@ -1,0 +1,260 @@
+"""HTTP plane: input hardening, error JSON contracts, /metrics, load
+shedding, and leak-free graceful shutdown."""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from albedo_tpu.datasets import synthetic_tables  # noqa: E402
+from albedo_tpu.datasets.tables import popular_repos  # noqa: E402
+from albedo_tpu.models.als import ImplicitALS  # noqa: E402
+from albedo_tpu.recommenders import PopularityRecommender  # noqa: E402
+from albedo_tpu.serving import RecommendationService, StageDeadlines, serve  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def artifacts():
+    tables = synthetic_tables(n_users=100, n_items=60, mean_stars=6, seed=13)
+    matrix = tables.star_matrix()
+    model = ImplicitALS(rank=8, max_iter=2, seed=0).fit(matrix)
+    return tables, matrix, model
+
+
+def _get(handle, path):
+    host, port = handle.server_address[:2]
+    try:
+        with urllib.request.urlopen(f"http://{host}:{port}{path}", timeout=30) as r:
+            return r.status, json.loads(r.read() or b"{}")
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}")
+
+
+def _post(handle, path):
+    host, port = handle.server_address[:2]
+    req = urllib.request.Request(
+        f"http://{host}:{port}{path}", data=b"", method="POST"
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return r.status, json.loads(r.read() or b"{}")
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}")
+
+
+@pytest.fixture(scope="module")
+def server(artifacts):
+    tables, matrix, model = artifacts
+    svc = RecommendationService(
+        model, matrix,
+        repo_info=tables.repo_info, user_info=tables.user_info,
+        cache_ttl=60.0,
+    )
+    with serve(svc, port=0) as handle:
+        yield handle, matrix
+
+
+def test_k_is_clamped_not_crashed(server):
+    handle, matrix = server
+    uid = int(matrix.user_ids[0])
+    for raw, expect in (("-5", 1), ("0", 1), ("999999999", None)):
+        status, body = _get(handle, f"/recommend/{uid}?k={raw}")
+        assert status == 200, (raw, body)
+        if expect is not None:
+            assert body["k"] == expect
+        else:
+            assert body["k"] == handle.service.max_k  # absurd k clamps to max
+
+
+def test_bad_int_params_are_400_json(server):
+    handle, matrix = server
+    uid = int(matrix.user_ids[0])
+    status, body = _get(handle, f"/recommend/{uid}?k=banana")
+    assert status == 400 and "k must be an integer" in body["error"]
+    status, body = _get(handle, "/recommend/not-a-number")
+    assert status == 400 and "user id" in body["error"]
+    status, body = _get(handle, "/admin/repos?limit=huge")
+    assert status == 400 and "limit" in body["error"]
+
+
+def test_admin_limit_clamped(server):
+    handle, _ = server
+    status, rows = _get(handle, "/admin/repos?limit=-3")
+    assert status == 200 and len(rows) <= 1
+    status, rows = _get(handle, "/admin/repos?limit=99999999")
+    assert status == 200  # clamped server-side, df.head never sees 1e8
+    status, rows = _get(handle, "/admin/users?q=" + "x" * 5000)
+    assert status == 200 and rows == []  # absurd q truncated, no hang
+
+
+def test_unexpected_exception_is_500_json(artifacts):
+    tables, matrix, model = artifacts
+    svc = RecommendationService(model, matrix)
+    svc.handle_recommend = None  # force a TypeError deep in the handler
+    with serve(svc, port=0) as handle:
+        status, body = _get(handle, f"/recommend/{int(matrix.user_ids[0])}")
+        assert status == 500
+        assert "internal error" in body["error"]
+        # The failure is visible in /metrics, and the server still serves.
+        status, _ = _get(handle, "/healthz")
+        assert status == 200
+        host, port = handle.server_address[:2]
+        with urllib.request.urlopen(f"http://{host}:{port}/metrics", timeout=30) as r:
+            text = r.read().decode()
+        assert 'albedo_requests_total{route="recommend",status="500"} 1' in text
+
+
+def test_queue_overflow_is_429_with_retry_after(artifacts):
+    tables, matrix, model = artifacts
+    svc = RecommendationService(model, matrix, max_queue=2, batch_window_ms=0.0)
+    # Wedge the batcher worker so the queue deterministically backs up.
+    release = threading.Event()
+    entered = threading.Event()
+
+    def slow_execute(k, mode, reqs):
+        entered.set()
+        release.wait(timeout=30)
+        for r in reqs:
+            if not r.future.done():
+                r.future.set_result(
+                    (np.zeros(k, np.float32), np.full(k, -1, np.int32))
+                )
+
+    svc.batcher._execute = slow_execute
+    try:
+        with serve(svc, port=0) as handle:
+            uid = int(matrix.user_ids[0])
+            results = []
+
+            def hit():
+                results.append(_get(handle, f"/recommend/{uid}?k=3"))
+
+            threads = []
+            # First request wedges the worker...
+            t0 = threading.Thread(target=hit)
+            t0.start()
+            threads.append(t0)
+            assert entered.wait(timeout=10)
+            # ...then enough traffic to overfill the 2-slot queue.
+            for _ in range(6):
+                t = threading.Thread(target=hit)
+                t.start()
+                threads.append(t)
+            deadline = time.monotonic() + 10
+            while (
+                not any(code == 429 for code, _ in results)
+                and time.monotonic() < deadline
+            ):
+                time.sleep(0.01)
+            release.set()
+            for t in threads:
+                t.join(timeout=30)
+            shed = [body for code, body in results if code == 429]
+            assert shed, f"no 429 in {[c for c, _ in results]}"
+            assert all("queue full" in body["error"] for body in shed)
+            assert svc.metrics.shed.value() >= len(shed)
+            host, port = handle.server_address[:2]
+            with urllib.request.urlopen(
+                f"http://{host}:{port}/metrics", timeout=30
+            ) as r:
+                assert "albedo_shed_total" in r.read().decode()
+    finally:
+        release.set()
+
+
+def test_degradation_matrix_over_http(artifacts):
+    """Acceptance: ranker-timeout, cold-artifacts, and overflow each return
+    well-formed JSON with the matching /metrics counter. Overflow is covered
+    above; this drives the other two through real sockets."""
+    tables, matrix, model = artifacts
+    pop = PopularityRecommender(
+        popular_repos(tables.repo_info, 1, 10**9), top_k=20
+    )
+
+    class SlowRanker:
+        def score(self, candidates):
+            time.sleep(2.0)
+            return candidates.assign(probability=0.5)
+
+    svc = RecommendationService(
+        model, matrix,
+        recommenders={"popularity": pop}, ranker=SlowRanker(),
+        deadlines=StageDeadlines(candidates_s=10.0, ranker_s=0.05),
+    )
+    with serve(svc, port=0) as handle:
+        status, body = _get(handle, f"/recommend/{int(matrix.user_ids[0])}?k=5")
+        assert status == 200
+        assert "ranker_timeout" in body["degraded"]
+        assert body["items"]
+        host, port = handle.server_address[:2]
+        with urllib.request.urlopen(f"http://{host}:{port}/metrics", timeout=30) as r:
+            text = r.read().decode()
+        assert 'albedo_degraded_total{reason="ranker_timeout"} 1' in text
+
+    cold = RecommendationService(None, matrix, recommenders={"popularity": pop})
+    with serve(cold, port=0) as handle:
+        status, body = _get(handle, f"/recommend/{int(matrix.user_ids[0])}?k=5")
+        assert status == 200
+        assert "cold_artifacts" in body["degraded"]
+        assert body["items"]
+        host, port = handle.server_address[:2]
+        with urllib.request.urlopen(f"http://{host}:{port}/metrics", timeout=30) as r:
+            assert 'albedo_degraded_total{reason="cold_artifacts"} 1' in r.read().decode()
+
+
+def test_cache_invalidate_endpoint(server):
+    handle, matrix = server
+    uid = int(matrix.user_ids[1])
+    _get(handle, f"/recommend/{uid}?k=4")
+    status, body = _post(handle, f"/cache/invalidate?user_id={uid}")
+    assert status == 200 and body["invalidated"] >= 1
+    status, body = _post(handle, "/cache/invalidate")
+    assert status == 200 and body["invalidated"] >= 0
+    status, body = _post(handle, "/cache/invalidate?user_id=nope")
+    assert status == 400
+    # GETting the POST-only route is a 404, not a crash.
+    status, _ = _get(handle, "/cache/invalidate")
+    assert status == 404
+
+
+def test_graceful_shutdown_leaks_no_threads(artifacts):
+    tables, matrix, model = artifacts
+    before = {t.name for t in threading.enumerate()}
+    svc = RecommendationService(model, matrix)
+    with serve(svc, port=0) as handle:
+        _get(handle, f"/recommend/{int(matrix.user_ids[0])}?k=3")
+        names = {t.name for t in threading.enumerate()}
+        assert any("albedo-http" in n for n in names)
+        assert any("albedo-micro-batcher" in n for n in names)
+    handle.shutdown()  # idempotent second call
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        leaked = {
+            t.name for t in threading.enumerate()
+            if t.name.startswith("albedo-")
+        } - before
+        if not leaked:
+            break
+        time.sleep(0.05)
+    assert not leaked, f"leaked threads: {leaked}"
+
+
+def test_metrics_endpoint_content_type(server):
+    handle, _ = server
+    host, port = handle.server_address[:2]
+    with urllib.request.urlopen(f"http://{host}:{port}/metrics", timeout=30) as r:
+        assert r.status == 200
+        assert r.headers["Content-Type"].startswith("text/plain")
+        text = r.read().decode()
+    for metric in (
+        "albedo_requests_total", "albedo_request_latency_seconds_bucket",
+        "albedo_serving_batch_size_bucket", "albedo_cache_hits_total",
+        "albedo_degraded_total", "albedo_shed_total",
+    ):
+        assert metric in text, metric

@@ -1,0 +1,119 @@
+"""Metrics plane: Prometheus text exposition and the shared Timer path."""
+
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from albedo_tpu.serving.metrics import (  # noqa: E402
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+)
+from albedo_tpu.utils.profiling import Timer  # noqa: E402
+
+
+def test_counter_labels_and_render():
+    c = Counter("t_total", "help text", ("route", "status"))
+    c.inc(route="recommend", status="200")
+    c.inc(route="recommend", status="200")
+    c.inc(route="admin", status="404")
+    assert c.value(route="recommend", status="200") == 2
+    lines = list(c.render())
+    assert 't_total{route="recommend",status="200"} 2' in lines
+    assert 't_total{route="admin",status="404"} 1' in lines
+
+
+def test_unlabelled_counter_renders_zero_sample():
+    c = Counter("z_total", "h")
+    assert list(c.render()) == ["z_total 0"]
+
+
+def test_gauge_set():
+    g = Gauge("g", "h", ("stage",))
+    g.set(1.5, stage="rank")
+    g.set(2.5, stage="rank")  # overwrite, not accumulate
+    assert list(g.render()) == ['g{stage="rank"} 2.5']
+
+
+def test_label_escaping():
+    c = Counter("e_total", "h", ("reason",))
+    c.inc(reason='quo"te\\slash')
+    (line,) = c.render()
+    assert line == 'e_total{reason="quo\\"te\\\\slash"} 1'
+
+
+def test_histogram_cumulative_buckets():
+    h = Histogram("lat_seconds", "h", buckets=(0.1, 1.0, 10.0))
+    for v in (0.05, 0.5, 0.5, 5.0, 50.0):
+        h.observe(v)
+    lines = list(h.render())
+    assert 'lat_seconds_bucket{le="0.1"} 1' in lines
+    assert 'lat_seconds_bucket{le="1"} 3' in lines
+    assert 'lat_seconds_bucket{le="10"} 4' in lines
+    assert 'lat_seconds_bucket{le="+Inf"} 5' in lines
+    assert "lat_seconds_count 5" in lines
+    (sum_line,) = [line for line in lines if line.startswith("lat_seconds_sum ")]
+    assert float(sum_line.split()[1]) == pytest.approx(56.05)
+
+
+def test_histogram_percentile_estimate():
+    h = Histogram("p", "h", buckets=(1.0, 2.0, 4.0))
+    for v in [0.5] * 50 + [1.5] * 45 + [3.0] * 5:
+        h.observe(v)
+    assert h.percentile(0.5) == 1.0   # bucket upper bound
+    assert h.percentile(0.99) == 4.0
+    assert Histogram("q", "h").percentile(0.99) == 0.0  # empty
+
+
+def test_registry_render_format():
+    reg = MetricsRegistry()
+    reg.requests.inc(route="recommend", status="200")
+    reg.request_latency.observe(0.003)
+    reg.degraded.inc(reason="ranker_timeout")
+    text = reg.render()
+    assert text.endswith("\n")
+    assert "# HELP albedo_requests_total" in text
+    assert "# TYPE albedo_requests_total counter" in text
+    assert "# TYPE albedo_request_latency_seconds histogram" in text
+    assert 'albedo_requests_total{route="recommend",status="200"} 1' in text
+    assert 'albedo_degraded_total{reason="ranker_timeout"} 1' in text
+    # Pre-registered zero-traffic metrics still expose samples.
+    assert "albedo_shed_total 0" in text
+
+
+def test_timer_snapshot_is_report_shaped():
+    """Timer.snapshot() is the one exchange format: totals identical to what
+    report() prints/returns, counts alongside."""
+    t = Timer()
+    with t.section("a"):
+        pass
+    with t.section("a"):
+        pass
+    with t.section("b"):
+        pass
+    snap = t.snapshot()
+    assert snap["counts"] == {"a": 2, "b": 1}
+    assert snap["totals"] == t.report(printer=lambda s: None)
+    # Snapshot is a copy, not a live view.
+    snap["totals"]["a"] = -1
+    assert t.totals["a"] >= 0
+
+
+def test_observe_timer_exports_stage_gauges():
+    reg = MetricsRegistry()
+    t = Timer()
+    with t.section("stage1_candidates"):
+        pass
+    reg.observe_timer(t)
+    text = reg.render()
+    assert 'albedo_stage_seconds{stage="stage1_candidates"}' in text
+    assert 'albedo_stage_calls{stage="stage1_candidates"} 1' in text
+
+
+def test_cache_hit_rate():
+    reg = MetricsRegistry()
+    assert reg.cache_hit_rate() == 0.0
+    reg.cache_hits.inc(3)
+    reg.cache_misses.inc()
+    assert reg.cache_hit_rate() == 0.75

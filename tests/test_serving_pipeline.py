@@ -1,0 +1,233 @@
+"""Two-stage online pipeline: fan-out/fusion, per-stage deadlines, and the
+degradation matrix (ranker timeout, cold artifacts, broken sources)."""
+
+import time
+
+import numpy as np
+import pandas as pd
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from albedo_tpu.datasets import synthetic_tables  # noqa: E402
+from albedo_tpu.datasets.tables import popular_repos  # noqa: E402
+from albedo_tpu.models.als import ImplicitALS  # noqa: E402
+from albedo_tpu.recommenders import PopularityRecommender  # noqa: E402
+from albedo_tpu.recommenders.base import Recommender  # noqa: E402
+from albedo_tpu.serving import RecommendationService, StageDeadlines  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def artifacts():
+    tables = synthetic_tables(n_users=100, n_items=60, mean_stars=8, seed=7)
+    matrix = tables.star_matrix()
+    model = ImplicitALS(rank=8, max_iter=3, seed=0).fit(matrix)
+    pop = PopularityRecommender(
+        popular_repos(tables.repo_info, 1, 10**9), top_k=20
+    )
+    return tables, matrix, model, pop
+
+
+class StubRanker:
+    """RankerModel stand-in: deterministic probability = item-id rank."""
+
+    def __init__(self, delay_s: float = 0.0, fail: bool = False, empty: bool = False):
+        self.delay_s = delay_s
+        self.fail = fail
+        self.empty = empty
+        self.calls = 0
+
+    def score(self, candidates: pd.DataFrame) -> pd.DataFrame:
+        self.calls += 1
+        if self.delay_s:
+            time.sleep(self.delay_s)
+        if self.fail:
+            raise RuntimeError("ranker exploded")
+        out = candidates.copy()
+        out["probability"] = 1.0 / (1.0 + out["repo_id"].astype(float))
+        if self.empty:
+            out = out.iloc[0:0]
+        return out
+
+
+def _service(artifacts, ranker=None, deadlines=None, model="als", **kw):
+    tables, matrix, als, pop = artifacts
+    return RecommendationService(
+        als if model == "als" else None,
+        matrix,
+        repo_info=tables.repo_info,
+        recommenders={"popularity": pop},
+        ranker=ranker,
+        deadlines=deadlines,
+        **kw,
+    )
+
+
+def test_two_stage_ranked_path(artifacts):
+    ranker = StubRanker()
+    with _service(artifacts, ranker=ranker) as svc:
+        _, matrix, _, _ = artifacts
+        uid = int(matrix.user_ids[0])
+        status, body = svc.handle_recommend(uid, k=10)
+        assert status == 200
+        assert body["stage"] == "two_stage"
+        assert body["degraded"] == []
+        assert ranker.calls == 1
+        assert len(body["items"]) == 10
+        # Ranked by probability descending.
+        probs = [i["score"] for i in body["items"]]
+        assert probs == sorted(probs, reverse=True)
+        # Fusion provenance survives re-ranking.
+        assert {i["source"] for i in body["items"]} <= {"als", "popularity"}
+        # ALS candidates exclude seen items on the two-stage path.
+        indptr, cols, _ = matrix.csr()
+        dense = matrix.users_of(np.array([uid]))[0]
+        seen = set(matrix.item_ids[cols[indptr[dense]:indptr[dense + 1]]].tolist())
+        als_items = {i["repo_id"] for i in body["items"] if i["source"] == "als"}
+        assert not (seen & als_items)
+
+
+def test_ranker_timeout_degrades_to_raw_als(artifacts):
+    slow = StubRanker(delay_s=2.0)
+    with _service(
+        artifacts, ranker=slow,
+        deadlines=StageDeadlines(candidates_s=10.0, ranker_s=0.05),
+    ) as svc:
+        _, matrix, _, _ = artifacts
+        uid = int(matrix.user_ids[1])
+        status, body = svc.handle_recommend(uid, k=5)
+        assert status == 200
+        assert "ranker_timeout" in body["degraded"]
+        assert body["stage"] == "stage1_als"  # raw ALS scores took over
+        assert body["items"] and all(i["source"] == "als" for i in body["items"])
+        assert svc.metrics.degraded.value(reason="ranker_timeout") == 1
+
+
+def test_ranker_error_degrades(artifacts):
+    with _service(artifacts, ranker=StubRanker(fail=True)) as svc:
+        _, matrix, _, _ = artifacts
+        status, body = svc.handle_recommend(int(matrix.user_ids[2]), k=5)
+        assert status == 200
+        assert "ranker_error" in body["degraded"]
+        assert body["items"]
+        assert svc.metrics.degraded.value(reason="ranker_error") == 1
+
+
+def test_ranker_cold_drop_all_degrades(artifacts):
+    with _service(artifacts, ranker=StubRanker(empty=True)) as svc:
+        _, matrix, _, _ = artifacts
+        status, body = svc.handle_recommend(int(matrix.user_ids[3]), k=5)
+        assert status == 200
+        assert "ranker_empty" in body["degraded"]
+        assert body["items"]
+
+
+def test_cold_artifacts_fall_back_to_popularity(artifacts):
+    """model=None (ALS artifacts missing): popularity keeps answering."""
+    with _service(artifacts, model=None) as svc:
+        _, matrix, _, _ = artifacts
+        status, body = svc.handle_recommend(int(matrix.user_ids[0]), k=5)
+        assert status == 200
+        assert "cold_artifacts" in body["degraded"]
+        assert body["items"] and all(i["source"] == "popularity" for i in body["items"])
+        assert svc.metrics.degraded.value(reason="cold_artifacts") == 1
+
+
+def test_cold_artifacts_without_any_fallback_is_503(artifacts):
+    _, matrix, _, _ = artifacts
+    with RecommendationService(None, matrix) as svc:
+        status, body = svc.handle_recommend(int(matrix.user_ids[0]), k=5)
+        assert status == 503
+        assert body["error"] and body["items"] == []
+
+
+def test_broken_candidate_source_degrades_not_500s(artifacts):
+    class Broken(Recommender):
+        source = "content"
+
+        def recommend_for_users(self, user_ids):
+            raise RuntimeError("index offline")
+
+    tables, matrix, als, pop = artifacts
+    with RecommendationService(
+        als, matrix,
+        recommenders={"popularity": pop, "content": Broken()},
+    ) as svc:
+        status, body = svc.handle_recommend(int(matrix.user_ids[0]), k=5)
+        assert status == 200
+        assert "candidate_error_content" in body["degraded"]
+        assert body["items"]
+
+
+def test_slow_candidate_source_times_out(artifacts):
+    class Slow(Recommender):
+        source = "content"
+
+        def recommend_for_users(self, user_ids):
+            time.sleep(5.0)
+            return pd.DataFrame()
+
+    tables, matrix, als, pop = artifacts
+    with RecommendationService(
+        als, matrix,
+        recommenders={"popularity": pop, "content": Slow()},
+        deadlines=StageDeadlines(candidates_s=0.2, ranker_s=0.5),
+    ) as svc:
+        t0 = time.monotonic()
+        status, body = svc.handle_recommend(int(matrix.user_ids[0]), k=5)
+        assert status == 200
+        assert time.monotonic() - t0 < 4.0  # deadline, not the source's 5s
+        assert "candidate_timeout_content" in body["degraded"]
+        assert body["items"]
+
+
+def test_two_stage_honors_exclude_seen_flag(artifacts):
+    """?exclude_seen=0 must reach the pipeline's ALS source (regression:
+    the flag was parsed, cache-keyed, then silently ignored)."""
+    with _service(artifacts, ranker=None) as svc:
+        _, matrix, _, _ = artifacts
+        indptr, cols, _ = matrix.csr()
+        lens = indptr[1:] - indptr[:-1]
+        dense = int(np.argmax(lens))  # user with the most history
+        uid = int(matrix.user_ids[dense])
+        seen = set(matrix.item_ids[cols[indptr[dense]:indptr[dense + 1]]].tolist())
+
+        _, body_ex = svc.handle_recommend(uid, k=20, exclude_seen=True)
+        als_ex = {i["repo_id"] for i in body_ex["items"] if i["source"] == "als"}
+        assert not (seen & als_ex)
+
+        _, body_in = svc.handle_recommend(uid, k=20, exclude_seen=False)
+        als_in = {i["repo_id"] for i in body_in["items"] if i["source"] == "als"}
+        # With history included, the strongest scores ARE the seen items.
+        assert seen & als_in
+
+
+def test_als_source_survives_topk_wider_than_catalog(artifacts):
+    """top_k > n_items: -inf pad entries carry indices >= n_items; the
+    source must mask before gathering item_ids (regression: IndexError,
+    silently degrading every request to candidate_error_als)."""
+    from albedo_tpu.serving import BatchedALSSource, MicroBatcher
+
+    _, matrix, model, _ = artifacts
+    batcher = MicroBatcher(model, window_ms=0.0)
+    try:
+        src = BatchedALSSource(batcher, matrix, top_k=matrix.n_items + 40)
+        frame = src.recommend_for_users(matrix.user_ids[:2])
+        assert len(frame)  # real items only, no crash
+        assert set(frame["repo_id"]).issubset(set(matrix.item_ids.tolist()))
+    finally:
+        batcher.stop()
+
+
+def test_stage_timings_reach_metrics(artifacts):
+    with _service(artifacts, ranker=StubRanker()) as svc:
+        _, matrix, _, _ = artifacts
+        svc.handle_recommend(int(matrix.user_ids[0]), k=5)
+        snap = svc.pipeline.timer.snapshot()
+        assert snap["counts"].get("stage1_candidates") == 1
+        assert snap["counts"].get("stage2_rank") == 1
+        # The /metrics handler refreshes the gauges from the timer at scrape
+        # time; emulate the scrape.
+        svc.metrics.observe_timer(svc.pipeline.timer)
+        text = svc.metrics.render()
+        assert 'albedo_stage_seconds{stage="stage1_candidates"}' in text
