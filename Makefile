@@ -7,9 +7,10 @@ ARGS ?=
 
 JOBS = popularity curation content train_als cv_als build_user_profile \
        build_repo_profile train_word2vec train_lr cv_lr item_cf user_cf \
-       tfidf_content ranking_mf collect_data drop_data sync_index serve play
+       tfidf_content ranking_mf collect_data drop_data sync_index serve play \
+       run_pipeline
 
-.PHONY: $(JOBS) test test-all bench serve-bench dryrun
+.PHONY: $(JOBS) test test-all bench serve-bench chaos dryrun
 
 $(JOBS):
 	$(PY) -m albedo_tpu.cli $@ $(ARGS)
@@ -29,6 +30,12 @@ bench:
 # DURATION/TRIALS/K).
 serve-bench:
 	$(PY) bench.py serving
+
+# Fault-injection drills: the full chaos matrix (corrupt-artifact healing,
+# kill/SIGTERM-resume parity through the real CLI, fault-injected serving
+# degradation over HTTP). CPU-safe; includes the slow subprocess drills.
+chaos:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m chaos
 
 dryrun:
 	$(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('ok')"

@@ -15,6 +15,7 @@ against each user's most recent 30 stars (``ALSRecommenderBuilder.scala:60-105``
 from __future__ import annotations
 
 import argparse
+import itertools
 import threading
 import time
 
@@ -62,6 +63,9 @@ class JobContext:
             raise ValueError("inject tables and tag together, or neither")
         self.tag = tag if tag is not None else md5(source)[:10]
         self._cache: dict[str, object] = {}
+        # Checkpoint dirs this process has already initialized: a retry of a
+        # failed stage must RESUME from this run's own steps, not wipe them.
+        self._ckpt_initialized: set[str] = set()
         if tables is not None:
             self._cache["tables"] = tables
         # Persistent executable reuse by default, even when a JobContext is
@@ -114,6 +118,47 @@ class JobContext:
             3 if steps is None else int(steps),
         )
 
+    def checkpoint_opts(self) -> tuple[int, bool, int | None]:
+        """(checkpoint_every, resume, keep_last) from the CLI flags;
+        ``--keep-last 0`` means keep every step (maps to None)."""
+        keep = getattr(self.args, "keep_last", 3)
+        keep = 3 if keep is None else int(keep)
+        return (
+            int(getattr(self.args, "checkpoint_every", 0) or 0),
+            bool(getattr(self.args, "resume", False)),
+            keep if keep > 0 else None,
+        )
+
+    def checkpointed_als(self, est, matrix, key: str):
+        """Preemption-safe ALS fit: checkpoints every ``--checkpoint-every``
+        iterations under ``checkpoint_dir/<tag>-<key>``, resumes from the
+        newest readable step under ``--resume``, and converts SIGTERM/SIGINT
+        into a checkpoint + :class:`~albedo_tpu.utils.checkpoint.Preempted`
+        clean exit (the CLI maps it to exit code 75)."""
+        import shutil
+
+        from albedo_tpu.settings import get_settings
+        from albedo_tpu.utils.checkpoint import (
+            PreemptionHandler,
+            checkpointed_als_fit,
+        )
+
+        every, resume, keep_last = self.checkpoint_opts()
+        ckdir = get_settings().checkpoint_dir / self.artifact_name(key)
+        if not resume and key not in self._ckpt_initialized and ckdir.exists():
+            # A fresh (non-resume) run must not silently adopt stale factors —
+            # but only on the FIRST fit per key: an in-process retry (e.g.
+            # run_pipeline's stage retry after a transient checkpoint-write
+            # error) resumes from the steps this very run just saved instead
+            # of deleting them and restarting from iteration 0.
+            shutil.rmtree(ckdir)
+        self._ckpt_initialized.add(key)
+        with PreemptionHandler() as preemption:
+            return checkpointed_als_fit(
+                est, matrix, ckdir, every=every, keep_last=keep_last,
+                preemption=preemption,
+            )
+
     def star_range(self) -> tuple[int, int]:
         # The reference's popular/profile star windows assume GitHub-scale
         # counts; synthetic tables are smaller.
@@ -132,10 +177,14 @@ class JobContext:
             key += f"-{solver}{cg_steps}"  # solver-tagged artifact, no mixups
 
         def train():
-            return ImplicitALS(
+            est = ImplicitALS(
                 rank=rank, reg_param=reg, alpha=alpha, max_iter=iters,
                 solver=solver, cg_steps=cg_steps,
-            ).fit(self.matrix())
+            )
+            every, _, _ = self.checkpoint_opts()
+            if every > 0:
+                return self.checkpointed_als(est, self.matrix(), key)
+            return est.fit(self.matrix())
 
         if "als" not in self._cache:
             from albedo_tpu.models.als import ALSModel
@@ -209,12 +258,17 @@ class JobContext:
             dim=dim, min_count=3 if self.small else 10, max_iter=iters, subsample=0.0
         )
 
+    def word2vec_artifact_name(self) -> str:
+        """The trained-w2v artifact name (one definition — the run_pipeline
+        journal records the same name this cache writes)."""
+        est = self.word2vec_estimator()
+        return self.artifact_name(f"word2VecModel-v2-{est.dim}-{est.max_iter}.pkl")
+
     def word2vec(self):
         from albedo_tpu.models.word2vec import Word2VecModel
 
         if "w2v" not in self._cache:
             est = self.word2vec_estimator()
-            dim, iters = est.dim, est.max_iter
 
             def train():
                 # Corpus built lazily inside the closure: a cache hit on the
@@ -222,8 +276,7 @@ class JobContext:
                 return est.fit_corpus(self.word2vec_corpus())
 
             arrays = load_or_create_pickle(
-                self.artifact_name(f"word2VecModel-v2-{dim}-{iters}.pkl"),
-                lambda: train().to_arrays(),
+                self.word2vec_artifact_name(), lambda: train().to_arrays()
             )
             self._cache["w2v"] = Word2VecModel(
                 vocab=list(arrays["vocab"]), vectors=np.asarray(arrays["vectors"], np.float32)
@@ -254,6 +307,7 @@ class JobContext:
             )
             print(f"[serve] ranker trained: AUC = {result.auc:.4f}")
             self._cache["ranker"] = result.model
+            self._cache["ranker_auc"] = float(result.auc)
         return self._cache["ranker"]
 
     def test_user_dense(self, n=250) -> np.ndarray:
@@ -365,10 +419,23 @@ def cv_als_job(args) -> None:
 
     solver, cg_steps = ctx.als_solver()
 
+    fit_no = itertools.count()
+
     def fit(params, train):
-        return ImplicitALS(
-            max_iter=iters, solver=solver, cg_steps=cg_steps, **params
-        ).fit(train)
+        est = ImplicitALS(max_iter=iters, solver=solver, cg_steps=cg_steps, **params)
+        every, _, _ = ctx.checkpoint_opts()
+        if every > 0:
+            # Per-(params, fold) checkpoint identity. cross_validate iterates
+            # params x folds in a deterministic order, so the sequential fit
+            # number is stable across reruns and -- unlike shape/nnz alone --
+            # can never collide between folds (two folds with equal nnz would
+            # otherwise share a dir and --resume would hand fold 2 fold 1's
+            # trained factors).
+            from albedo_tpu.settings import md5
+
+            key = md5(f"{sorted(params.items())}-fit{next(fit_no)}")[:12]
+            return ctx.checkpointed_als(est, train, f"cvALS-{key}")
+        return est.fit(train)
 
     def evaluate(model, train, test):
         users = sample_test_users(test, n=150)

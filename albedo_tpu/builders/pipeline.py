@@ -1,0 +1,266 @@
+"""The full offline chain from one command, with a journal and resume.
+
+``albedo-tpu run_pipeline`` drives the paper's batch-job DAG — popularity ->
+ALS -> user/repo profiles -> word2vec -> LR ranker — the way the reference's
+Makefile drives its spark-submit targets one by one, but fault-tolerantly:
+
+- every stage is recorded in a per-run JSON **journal**
+  (``<tag>-pipeline-journal.json`` in the artifact dir): status
+  (``running``/``done``/``failed``), attempt count, wall-clock, the artifact
+  names it materialized, and a compact result (rows, AUC, ...);
+- ``--resume`` skips stages the journal already marks ``done`` — combined
+  with the artifact store's own memoization this makes a rerun after ANY
+  crash cheap: completed stages don't even pay an artifact load;
+- each stage retries with the shared jittered backoff
+  (``utils.retry``) before the pipeline gives up, because transient IO —
+  a flaky NFS mount, a preempted colocated job — should cost a retry, not
+  the whole chain;
+- the ``pipeline.stage`` / ``pipeline.stage.<name>`` fault sites
+  (``utils.faults``) let chaos tests kill, delay, or fail any stage
+  deterministically.
+
+MLlib pipeline-persistence parity (arxiv 1505.06807): the journal + the
+date-keyed artifact store together are the persistence layer — every stage's
+product is reloadable by name, and the journal is the pipeline's saved
+execution state.
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+from pathlib import Path
+from typing import Callable
+
+from albedo_tpu.cli import register_job
+from albedo_tpu.utils import faults
+from albedo_tpu.utils.checkpoint import Preempted
+from albedo_tpu.utils.jsonio import atomic_write_json, read_json_or_none
+from albedo_tpu.utils.retry import RetryPolicy, retry_call
+
+_STAGE_FAULT = faults.site("pipeline.stage")
+
+JOURNAL_NAME = "pipeline-journal.json"
+
+
+class PipelineStageFailed(RuntimeError):
+    """A stage exhausted its retries; the journal holds the failure record."""
+
+    def __init__(self, stage: str, cause: BaseException):
+        super().__init__(f"pipeline stage {stage!r} failed: {cause!r}")
+        self.stage = stage
+        self.cause = cause
+
+
+# --- stages -------------------------------------------------------------------
+# Each stage: fn(ctx) -> (result_dict, artifact_names). Stages lean on the
+# artifact store / JobContext memoization, so a resumed or repeated stage is
+# a cheap load, and a regenerated (quarantined) artifact is rebuilt here.
+
+
+def _stage_popularity(ctx) -> tuple[dict, list[str]]:
+    from albedo_tpu.datasets.artifacts import load_or_create_df
+    from albedo_tpu.datasets.tables import popular_repos
+
+    lo, hi = ctx.star_range()
+    name = ctx.artifact_name("popularRepoDF.parquet")
+    df = load_or_create_df(name, lambda: popular_repos(ctx.tables().repo_info, lo, hi))
+    return {"rows": int(len(df))}, [name]
+
+
+def _stage_train_als(ctx) -> tuple[dict, list[str]]:
+    model = ctx.als_model()
+    return {"rank": int(model.rank)}, []
+
+
+def _stage_user_profile(ctx) -> tuple[dict, list[str]]:
+    from albedo_tpu.datasets.artifacts import load_or_create_df
+
+    name = ctx.artifact_name("userProfileDF.parquet")
+    df = load_or_create_df(name, lambda: ctx.profiles()[0])
+    return {"rows": int(len(df))}, [name]
+
+
+def _stage_repo_profile(ctx) -> tuple[dict, list[str]]:
+    from albedo_tpu.datasets.artifacts import load_or_create_df
+
+    name = ctx.artifact_name("repoProfileDF.parquet")
+    df = load_or_create_df(name, lambda: ctx.profiles()[2])
+    return {"rows": int(len(df))}, [name]
+
+
+def _stage_word2vec(ctx) -> tuple[dict, list[str]]:
+    model = ctx.word2vec()
+    return {"vocab": int(len(model.vocab))}, [ctx.word2vec_artifact_name()]
+
+
+def _stage_train_lr(ctx) -> tuple[dict, list[str]]:
+    ctx.ranker_model()
+    auc = ctx._cache.get("ranker_auc")
+    return {"auc": float(auc) if auc is not None else None}, []
+
+
+STAGES: tuple[tuple[str, Callable], ...] = (
+    ("popularity", _stage_popularity),
+    ("train_als", _stage_train_als),
+    ("user_profile", _stage_user_profile),
+    ("repo_profile", _stage_repo_profile),
+    ("word2vec", _stage_word2vec),
+    ("train_lr", _stage_train_lr),
+)
+
+
+# --- the journal --------------------------------------------------------------
+
+
+def _empty_journal(tag: str) -> dict:
+    return {"tag": tag, "status": "running", "stages": {}, "updated_at": time.time()}
+
+
+def load_journal(path: Path) -> dict | None:
+    return read_json_or_none(path)
+
+
+def _save_journal(path: Path, journal: dict) -> None:
+    journal["updated_at"] = time.time()
+    atomic_write_json(path, journal, indent=2)
+
+
+# --- the driver ---------------------------------------------------------------
+
+
+def run_pipeline(
+    ctx,
+    *,
+    resume: bool = False,
+    stages: list[str] | None = None,
+    max_stage_attempts: int = 3,
+    policy: RetryPolicy | None = None,
+    sleeper: Callable[[float], None] = time.sleep,
+    verbose: bool = True,
+) -> dict:
+    """Run the offline chain; returns the final journal dict.
+
+    ``resume=True`` skips stages already ``done`` in the journal. A stage
+    that exhausts its retries marks the journal ``failed`` (persisted) and
+    raises :class:`PipelineStageFailed` — the rerun story is
+    ``run_pipeline --resume``.
+    """
+    from albedo_tpu.datasets.artifacts import artifact_path
+
+    journal_path = artifact_path(ctx.artifact_name(JOURNAL_NAME))
+    journal = (load_journal(journal_path) if resume else None) or _empty_journal(ctx.tag)
+    journal["status"] = "running"
+
+    selected = [(n, fn) for n, fn in STAGES if stages is None or n in stages]
+    if stages is not None:
+        unknown = set(stages) - {n for n, _ in STAGES}
+        if unknown:
+            raise ValueError(f"unknown pipeline stages: {sorted(unknown)}")
+
+    policy = policy or RetryPolicy(
+        max_attempts=max_stage_attempts, base_s=0.5, max_delay_s=30.0
+    )
+    for name, fn in selected:
+        record = journal["stages"].get(name)
+        if resume and record and record.get("status") == "done":
+            if verbose:
+                print(f"[run_pipeline] {name}: already done, skipping (resume)")
+            continue
+        record = {
+            "status": "running",
+            "attempts": 0,
+            "started_at": time.time(),
+            "finished_at": None,
+            "artifacts": [],
+            "result": None,
+            "error": None,
+        }
+        journal["stages"][name] = record
+        _save_journal(journal_path, journal)
+
+        def attempt(name=name, fn=fn, record=record):
+            record["attempts"] += 1
+            _STAGE_FAULT.hit()
+            faults.hit(f"pipeline.stage.{name}")
+            return fn(ctx)
+
+        t0 = time.time()
+        try:
+            result, artifacts = retry_call(
+                attempt, policy=policy, site=f"pipeline.{name}",
+                sleeper=sleeper,
+                # A preemption notice is NOT a transient failure: retrying
+                # would restart training under a scheduler that is about to
+                # hard-kill us. Let it propagate for the CLI's exit-75 path.
+                retry_on=lambda e: not isinstance(e, Preempted),
+            )
+        except Preempted:
+            record.update(status="preempted", finished_at=time.time())
+            journal["status"] = "preempted"
+            _save_journal(journal_path, journal)
+            raise  # cli.main maps this to exit 75; --resume continues
+        except Exception as e:  # noqa: BLE001 — journal the failure, then raise
+            record.update(status="failed", error=repr(e), finished_at=time.time())
+            journal["status"] = "failed"
+            _save_journal(journal_path, journal)
+            raise PipelineStageFailed(name, e) from e
+        record.update(
+            status="done", result=result, artifacts=artifacts,
+            finished_at=time.time(), error=None,
+        )
+        _save_journal(journal_path, journal)
+        if verbose:
+            print(
+                f"[run_pipeline] {name}: done in {time.time() - t0:.1f}s "
+                f"(attempts={record['attempts']}, result={result})"
+            )
+
+    # "complete" is a statement about the WHOLE chain — a --stages subset run
+    # that finished cleanly but skipped stages is "partial", so journal
+    # consumers can't mistake a popularity-only run for a trained pipeline.
+    journal["status"] = (
+        "complete"
+        if all(
+            journal["stages"].get(n, {}).get("status") == "done" for n, _ in STAGES
+        )
+        else "partial"
+    )
+    _save_journal(journal_path, journal)
+    return journal
+
+
+@register_job("run_pipeline")
+def run_pipeline_job(args) -> int | None:
+    """The one-command offline chain (see module docstring).
+
+    Extra flags: --stages a,b,c (subset, in canonical order),
+    --max-stage-attempts N (default 3). Honors the global --resume,
+    --checkpoint-every/--keep-last (ALS mid-fit checkpoints), --small,
+    --tables.
+    """
+    from albedo_tpu.builders.jobs import JobContext
+
+    extra = argparse.ArgumentParser()
+    extra.add_argument("--stages", default="")
+    extra.add_argument("--max-stage-attempts", type=int, default=3)
+    ns, _ = extra.parse_known_args(getattr(args, "_rest", []))
+
+    t0 = time.time()
+    ctx = JobContext(args)
+    stages = [s for s in ns.stages.split(",") if s] or None
+    try:
+        journal = run_pipeline(
+            ctx,
+            resume=bool(getattr(args, "resume", False)),
+            stages=stages,
+            max_stage_attempts=ns.max_stage_attempts,
+        )
+    except PipelineStageFailed as e:
+        print(f"[run_pipeline] FAILED: {e} (journal has the record; rerun "
+              f"with --resume to retry from there)")
+        return 1
+    done = [n for n, r in journal["stages"].items() if r["status"] == "done"]
+    print(f"[run_pipeline] stages complete = {len(done)}/{len(journal['stages'])}")
+    print(f"[run_pipeline] wall-clock = {time.time() - t0:.1f}s")
+    return None

@@ -47,6 +47,26 @@ def main(argv: list[str] | None = None) -> int:
         "--cg-steps", type=int, default=3, help="CG steps per half-sweep (--solver cg)"
     )
     parser.add_argument(
+        "--checkpoint-every",
+        type=int,
+        default=0,
+        help="checkpoint ALS factors every N iterations (0 = off); a killed "
+        "run rerun with --resume continues from the latest readable step",
+    )
+    parser.add_argument(
+        "--resume",
+        action="store_true",
+        help="resume from existing checkpoints / completed pipeline stages "
+        "instead of starting over (train_als, cv_als, run_pipeline)",
+    )
+    parser.add_argument(
+        "--keep-last",
+        type=int,
+        default=3,
+        help="checkpoint retention: keep the newest N steps (default 3; "
+        "0 = keep every step)",
+    )
+    parser.add_argument(
         "--no-compilation-cache",
         action="store_true",
         help="disable the persistent XLA executable cache (on by default; "
@@ -91,7 +111,22 @@ def main(argv: list[str] | None = None) -> int:
     n_proc = init_distributed()
     if n_proc > 1:
         print(f"[cli] joined distributed world: {n_proc} processes")
-    rc = _JOBS[args.job](args)
+    # init_distributed imported jax: re-invoke the cache enabler so the
+    # torn-write hardening patch (harden_jax_cache_writes) is applied — the
+    # first call above ran before jax existed and could only set env vars.
+    if not args.no_compilation_cache:
+        from albedo_tpu.utils.compilation_cache import enable_persistent_compilation_cache
+
+        enable_persistent_compilation_cache()
+    from albedo_tpu.utils.checkpoint import Preempted
+
+    try:
+        rc = _JOBS[args.job](args)
+    except Preempted as e:
+        # SIGTERM/SIGINT landed mid-fit and the loop checkpointed: exit
+        # clean-but-incomplete (EX_TEMPFAIL) so schedulers rerun with --resume.
+        print(f"[cli] {e}; rerun with --resume to continue", file=sys.stderr)
+        return 75
     # Jobs may return an int exit code (e.g. drop_data's refusal); None = ok.
     return int(rc) if isinstance(rc, int) else 0
 

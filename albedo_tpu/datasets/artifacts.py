@@ -1,4 +1,4 @@
-"""Date-keyed artifact cache: the load-or-create memoization idiom.
+"""Date-keyed artifact cache: load-or-create memoization with integrity.
 
 Reference parity: ``DatasetUtils.loadOrCreateDataFrame`` (``utils/DatasetUtils.scala:36-50``)
 and ``ModelUtils.loadOrCreateModel`` (``utils/ModelUtils.scala:7-21``) — every
@@ -9,24 +9,132 @@ last materialized artifact (SURVEY.md section 5).
 
 Hyperparameters belong in the artifact name, as the reference bakes them into
 paths like ``rankerModelPipeline-$maxStarredReposCount-...parquet``.
+
+Integrity (beyond the reference): every write leaves a ``<name>.sha256``
+sidecar manifest (content hash + size). A later load first verifies the
+manifest; a checksum mismatch, or a load that raises, **quarantines** the
+artifact to ``<name>.corrupt-<n>`` and falls through to regenerate — one
+truncated pickle no longer bricks every "resumable" rerun. Quarantines are
+counted in the process-global ``albedo_artifact_corruptions_total{artifact=}``
+counter (``utils.events``), which the serving `/metrics` page renders.
+Fault sites ``artifact.load`` / ``artifact.save`` (``utils.faults``) let
+chaos tests flip bytes or fail IO exactly here.
 """
 
 from __future__ import annotations
 
+import hashlib
+import itertools
 import json
+import logging
+import shutil
+import time
 from pathlib import Path
 from typing import Any, Callable, TypeVar
 
 import numpy as np
 
 from albedo_tpu.settings import get_settings
+from albedo_tpu.utils import events, faults
+from albedo_tpu.utils.jsonio import atomic_write_json
+
+log = logging.getLogger(__name__)
 
 T = TypeVar("T")
+
+MANIFEST_SUFFIX = ".sha256"
+
+_LOAD_FAULT = faults.site("artifact.load")
+_SAVE_FAULT = faults.site("artifact.save")
 
 
 def artifact_path(name: str) -> Path:
     s = get_settings().ensure_dirs()
     return s.artifact_dir / name
+
+
+# --- integrity ----------------------------------------------------------------
+
+
+def file_sha256(path: Path) -> str:
+    """Streamed SHA-256 of a file, or of a directory's files in sorted
+    relative-path order (path names are hashed too, so a renamed member
+    changes the digest)."""
+    h = hashlib.sha256()
+    path = Path(path)
+    targets = (
+        sorted(p for p in path.rglob("*") if p.is_file())
+        if path.is_dir()
+        else [path]
+    )
+    for p in targets:
+        if path.is_dir():
+            h.update(str(p.relative_to(path)).encode())
+        with open(p, "rb") as f:
+            for chunk in iter(lambda: f.read(1 << 20), b""):
+                h.update(chunk)
+    return h.hexdigest()
+
+
+def _size(path: Path) -> int:
+    path = Path(path)
+    if path.is_dir():
+        return sum(p.stat().st_size for p in path.rglob("*") if p.is_file())
+    return path.stat().st_size
+
+
+def manifest_path(path: Path) -> Path:
+    return path.with_name(path.name + MANIFEST_SUFFIX)
+
+
+def write_manifest(path: Path) -> Path:
+    """Record ``path``'s content hash + size next to it (atomic write)."""
+    path = Path(path)
+    return atomic_write_json(manifest_path(path), {
+        "sha256": file_sha256(path),
+        "size": _size(path),
+        "created_at": time.time(),
+    })
+
+
+def verify_manifest(path: Path) -> bool | None:
+    """True = hash matches, False = mismatch (corruption), None = no/unreadable
+    manifest (pre-manifest artifact: loadable but unverifiable)."""
+    mpath = manifest_path(Path(path))
+    if not mpath.exists():
+        return None
+    try:
+        manifest = json.loads(mpath.read_text())
+        expected = str(manifest["sha256"])
+    except (ValueError, KeyError, OSError):
+        return None  # garbage sidecar: fall back to trusting the load
+    return file_sha256(path) == expected
+
+
+def quarantine(path: Path, reason: str = "corrupt") -> Path:
+    """Move a bad artifact (and its manifest) aside to ``<name>.corrupt-<n>``
+    so the evidence survives for debugging while the slot regenerates."""
+    path = Path(path)
+    for n in itertools.count(1):
+        dest = path.with_name(f"{path.name}.corrupt-{n}")
+        if not dest.exists():
+            break
+    path.rename(dest)
+    mpath = manifest_path(path)
+    if mpath.exists():
+        mpath.rename(dest.with_name(dest.name + MANIFEST_SUFFIX))
+    log.warning("quarantined artifact %s -> %s (%s)", path.name, dest.name, reason)
+    return dest
+
+
+def _remove(path: Path) -> None:
+    if path.is_dir():
+        shutil.rmtree(path)
+    elif path.exists():
+        path.unlink()
+
+
+# --- the memoization core -----------------------------------------------------
 
 
 def load_or_create(
@@ -38,22 +146,32 @@ def load_or_create(
     """Generic memoization: load ``name`` if materialized, else create+save.
 
     Writes go through a temp path + rename so a killed job never leaves a
-    half-written artifact that a resume would trust.
+    half-written artifact that a resume would trust, and every write leaves a
+    checksum manifest. Loads verify-then-trust: a failed verification or a
+    raising ``load`` quarantines the file and regenerates instead of
+    crashing the rerun.
     """
     path = artifact_path(name)
     if path.exists():
-        return load(path)
+        # Chaos hook: a 'corrupt' fault flips a byte of the real artifact
+        # here, BEFORE verification — exercising the quarantine path below.
+        _LOAD_FAULT.hit(path=path)
+        if verify_manifest(path) is False:
+            quarantine(path, reason="checksum mismatch")
+            events.artifact_corruptions.inc(artifact=name)
+        else:
+            try:
+                return load(path)
+            except Exception as e:  # noqa: BLE001 — any unreadable artifact regenerates
+                quarantine(path, reason=f"load failed: {type(e).__name__}")
+                events.artifact_corruptions.inc(artifact=name)
     value = create()
     tmp = path.with_name(path.name + ".tmp")
-    if tmp.exists():
-        if tmp.is_dir():
-            import shutil
-
-            shutil.rmtree(tmp)
-        else:
-            tmp.unlink()
+    _remove(tmp)
     save(tmp, value)
+    _SAVE_FAULT.hit(path=tmp)
     tmp.rename(path)
+    write_manifest(path)
     return value
 
 

@@ -1,150 +1,33 @@
-"""Observability plane: counters, gauges, histograms, Prometheus exposition.
+"""Observability plane: the serving metrics registry and `/metrics` page.
 
 The reference deployment watches the Django app from the outside (Spark UI
 for jobs, MySQL slow log for the store); the online engine needs first-class
-metrics of its own. This module is a minimal, dependency-free subset of the
-Prometheus client data model — enough for `/metrics` to be scraped by a real
-Prometheus — kept deliberately tiny so the serving hot path pays one dict
-update and one lock per observation.
+metrics of its own. The Prometheus-compatible primitives
+(:class:`Counter`/:class:`Gauge`/:class:`Histogram`, text format 0.0.4) live
+in ``utils.events`` — dependency-free, shared with the offline layers — and
+are re-exported here for compatibility; this module owns the serving
+registry.
 
-Exposition follows the text format 0.0.4 (`# HELP` / `# TYPE` lines,
-cumulative `_bucket{le=...}` histogram rows, `_sum`/`_count` totals).
 Per-stage wall-clock comes from ``utils.profiling.Timer.snapshot()`` — the
 SAME accumulator the fit reports print, so offline and online timings share
-one code path.
+one code path. ``render()`` also appends the process-global offline counters
+(``utils.events.global_metrics()``): artifact corruption quarantines,
+checkpoint restore fallbacks, retry attempts, and injected-fault firings all
+surface on the same `/metrics` page the serving plane exposes.
 """
 
 from __future__ import annotations
 
 import threading
-from typing import Iterable, Mapping
 
-# Latency-oriented default buckets (seconds): sub-ms dispatches up to
-# multi-second degraded responses.
-DEFAULT_TIME_BUCKETS = (
-    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0,
-    2.5, 5.0,
+from albedo_tpu.utils.events import (  # noqa: F401  (re-exported API)
+    DEFAULT_SIZE_BUCKETS,
+    DEFAULT_TIME_BUCKETS,
+    Counter,
+    Gauge,
+    Histogram,
+    global_metrics,
 )
-# Batch-size buckets: the power-of-two shape ladder the micro-batcher pads to.
-DEFAULT_SIZE_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0)
-
-
-def _fmt_value(v: float) -> str:
-    """Prometheus renders integers bare and floats as-is; +Inf specially."""
-    if v == float("inf"):
-        return "+Inf"
-    if float(v).is_integer():
-        return str(int(v))
-    return repr(float(v))
-
-
-def _escape_label(v: str) -> str:
-    return str(v).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
-
-
-def _fmt_labels(labels: Mapping[str, str] | None) -> str:
-    if not labels:
-        return ""
-    inner = ",".join(f'{k}="{_escape_label(v)}"' for k, v in sorted(labels.items()))
-    return "{" + inner + "}"
-
-
-class Counter:
-    """Monotonic counter, optionally labelled (one child per label set)."""
-
-    kind = "counter"
-
-    def __init__(self, name: str, help_: str, label_names: tuple[str, ...] = ()):
-        self.name = name
-        self.help = help_
-        self.label_names = tuple(label_names)
-        self._values: dict[tuple[str, ...], float] = {}
-        self._lock = threading.Lock()
-
-    def inc(self, amount: float = 1.0, **labels: str) -> None:
-        key = tuple(str(labels.get(n, "")) for n in self.label_names)
-        with self._lock:
-            self._values[key] = self._values.get(key, 0.0) + amount
-
-    def value(self, **labels: str) -> float:
-        key = tuple(str(labels.get(n, "")) for n in self.label_names)
-        with self._lock:
-            return self._values.get(key, 0.0)
-
-    def render(self) -> Iterable[str]:
-        with self._lock:
-            items = sorted(self._values.items())
-        if not items and not self.label_names:
-            items = [((), 0.0)]  # unlabelled counters always expose a sample
-        for key, value in items:
-            labels = dict(zip(self.label_names, key))
-            yield f"{self.name}{_fmt_labels(labels)} {_fmt_value(value)}"
-
-
-class Gauge(Counter):
-    """Settable value; shares the labelled-children plumbing of Counter."""
-
-    kind = "gauge"
-
-    def set(self, value: float, **labels: str) -> None:
-        key = tuple(str(labels.get(n, "")) for n in self.label_names)
-        with self._lock:
-            self._values[key] = float(value)
-
-
-class Histogram:
-    """Cumulative-bucket histogram (unlabelled — one series per metric)."""
-
-    kind = "histogram"
-
-    def __init__(self, name: str, help_: str, buckets: tuple[float, ...] = DEFAULT_TIME_BUCKETS):
-        self.name = name
-        self.help = help_
-        self.buckets = tuple(sorted(buckets))
-        self._counts = [0] * (len(self.buckets) + 1)  # +1 for +Inf
-        self._sum = 0.0
-        self._count = 0
-        self._lock = threading.Lock()
-
-    def observe(self, value: float) -> None:
-        value = float(value)
-        with self._lock:
-            self._sum += value
-            self._count += 1
-            for i, b in enumerate(self.buckets):
-                if value <= b:
-                    self._counts[i] += 1
-                    return
-            self._counts[-1] += 1
-
-    def snapshot(self) -> dict:
-        """(count, sum, per-bucket cumulative counts) under one lock."""
-        with self._lock:
-            cum, total = [], 0
-            for c in self._counts:
-                total += c
-                cum.append(total)
-            return {"count": self._count, "sum": self._sum, "cumulative": cum}
-
-    def percentile(self, q: float) -> float:
-        """Bucket-resolution percentile estimate (upper bound of the bucket
-        holding the q-quantile observation) — for bench summaries, not SLOs."""
-        snap = self.snapshot()
-        if snap["count"] == 0:
-            return 0.0
-        target = q * snap["count"]
-        for i, c in enumerate(snap["cumulative"][:-1]):
-            if c >= target:
-                return self.buckets[i]
-        return float("inf")
-
-    def render(self) -> Iterable[str]:
-        snap = self.snapshot()
-        edges = [*self.buckets, float("inf")]
-        for edge, c in zip(edges, snap["cumulative"]):
-            yield f'{self.name}_bucket{{le="{_fmt_value(edge)}"}} {c}'
-        yield f"{self.name}_sum {_fmt_value(snap['sum'])}"
-        yield f"{self.name}_count {snap['count']}"
 
 
 class MetricsRegistry:
@@ -234,7 +117,9 @@ class MetricsRegistry:
         lines: list[str] = []
         with self._lock:
             metrics = list(self._metrics)
-        for m in metrics:
+        # Process-global offline counters (artifact quarantines, checkpoint
+        # fallbacks, retries, injected faults) ride every exposition.
+        for m in [*metrics, *global_metrics()]:
             lines.append(f"# HELP {m.name} {m.help}")
             lines.append(f"# TYPE {m.name} {m.kind}")
             lines.extend(m.render())

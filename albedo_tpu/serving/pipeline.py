@@ -33,7 +33,13 @@ from albedo_tpu.datasets.ragged import csr_row
 from albedo_tpu.datasets.star_matrix import StarMatrix
 from albedo_tpu.recommenders.base import Recommender, fuse_candidates
 from albedo_tpu.serving.batcher import MicroBatcher
+from albedo_tpu.utils import faults
 from albedo_tpu.utils.profiling import Timer
+
+# Chaos hooks (utils.faults): armed faults here surface as the SAME degraded
+# responses real source/ranker failures produce — tests drive the degradation
+# matrix end-to-end over HTTP instead of hand-stubbing broken recommenders.
+_RANK_FAULT = faults.site("serving.rank")
 
 # Fusion priority: duplicates keep the FIRST source's row (reference
 # ``reduce(union).distinct`` keeps one arbitrary row; we pin the order so
@@ -166,12 +172,15 @@ class TwoStagePipeline:
         popularity/curation/content don't filter by history, as in the
         reference fusion."""
         users = np.array([int(user_id)], dtype=np.int64)
+
+        def call_source(name: str, rec: Recommender) -> pd.DataFrame:
+            faults.hit(f"serving.source.{name}")
+            if isinstance(rec, BatchedALSSource):
+                return rec.recommend_for_users(users, exclude_seen)
+            return rec.recommend_for_users(users)
+
         futs: dict[str, Future] = {
-            name: (
-                self._pool.submit(rec.recommend_for_users, users, exclude_seen)
-                if isinstance(rec, BatchedALSSource)
-                else self._pool.submit(rec.recommend_for_users, users)
-            )
+            name: self._pool.submit(call_source, name, rec)
             for name, rec in self.recommenders.items()
         }
         deadline = time.monotonic() + self.deadlines.candidates_s
@@ -187,6 +196,7 @@ class TwoStagePipeline:
         return frames
 
     def _rank(self, candidates: pd.DataFrame) -> pd.DataFrame:
+        _RANK_FAULT.hit()
         return self.ranker.score(candidates)
 
     def recommend(self, user_id: int, k: int, exclude_seen: bool = True) -> dict:

@@ -15,6 +15,15 @@ Dedup is the store's unique constraints, as the reference swallows
 ``IntegrityError``. The HTTP layer is an injected ``transport`` callable so
 the crawler is fully testable offline (this environment has no egress); the
 default transport uses ``urllib`` against api.github.com.
+
+Retry policy (upgraded from the reference's fixed sleeps): transient
+failures (5xx, injected IO errors) back off exponentially with full jitter
+through the shared ``utils.retry`` machinery; rate limits (403/429) honor
+the server's own ``Retry-After`` / ``X-RateLimit-Reset`` headers when the
+transport surfaces them, and only fall back to the reference's blunt
+30-minute nap (:60-66) when GitHub doesn't say. ``stats.rate_limit_sleeps``
+still counts every rate-limit wait. The ``crawler.transport`` fault site
+(``utils.faults``) injects IO errors/delays ahead of every real request.
 """
 
 from __future__ import annotations
@@ -29,20 +38,41 @@ from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Callable, Iterable
 
 from albedo_tpu.store.store import EntityStore
+from albedo_tpu.utils import faults
+from albedo_tpu.utils.retry import RetriesExhausted, RetryAfter, RetryPolicy, retry_call
 
-Transport = Callable[[str, dict[str, Any], str | None], tuple[int, Any]]
+# Transports return (status, json) or (status, json, headers) — the 2-tuple
+# form keeps every pre-existing fake transport working; headers (a str->str
+# mapping, case-insensitive keys not assumed) unlock Retry-After handling.
+Transport = Callable[[str, dict[str, Any], str | None], tuple]
 
-RATE_LIMIT_SLEEP_S = 30 * 60  # :60-66
+RATE_LIMIT_SLEEP_S = 30 * 60  # header-less fallback (:60-66)
 MAX_RETRIES = 5
 PER_PAGE = 100
 CONCURRENCY = 6  # ThreadPoolExecutor(6), :85
+
+# Transient-failure backoff: 5 attempts, 0.5s -> 8s full-jittered (replaces
+# the reference's fixed sleep(1.0) between retries).
+TRANSIENT_POLICY = RetryPolicy(max_attempts=MAX_RETRIES, base_s=0.5, max_delay_s=8.0)
+
+_TRANSPORT_FAULT = faults.site("crawler.transport")
 
 
 class RateLimited(Exception):
     pass
 
 
-def default_transport(path: str, params: dict[str, Any], token: str | None) -> tuple[int, Any]:
+class TransientHTTPError(Exception):
+    """A retryable non-200/403/404 response (5xx, connection resets)."""
+
+    def __init__(self, status: int, path: str):
+        super().__init__(f"HTTP {status} on {path}")
+        self.status = status
+
+
+def default_transport(
+    path: str, params: dict[str, Any], token: str | None
+) -> tuple[int, Any, dict[str, str]]:
     """GET api.github.com/<path> with urllib (real-network path)."""
     import urllib.parse
     import urllib.request
@@ -56,10 +86,35 @@ def default_transport(path: str, params: dict[str, Any], token: str | None) -> t
         req.add_header("Authorization", f"token {token}")
     try:
         with urllib.request.urlopen(req, timeout=30) as resp:
-            return resp.status, _json.loads(resp.read().decode("utf-8"))
+            return resp.status, _json.loads(resp.read().decode("utf-8")), dict(resp.headers)
     except Exception as e:  # urllib raises on 4xx/5xx
         status = getattr(e, "code", 599)
-        return int(status), None
+        headers = dict(getattr(e, "headers", None) or {})
+        return int(status), None, headers
+
+
+def rate_limit_delay(
+    headers: dict[str, Any] | None, now: Callable[[], float] = time.time
+) -> float:
+    """Seconds to wait out a 403/429: ``Retry-After`` wins, then
+    ``X-RateLimit-Reset`` (epoch seconds), then the reference's 30 minutes.
+    Server values are clamped to that same 30-minute ceiling — one bogus
+    header (or a reset timestamp in milliseconds) must not park a crawler
+    thread for days."""
+    headers = {str(k).lower(): v for k, v in (headers or {}).items()}
+    retry_after = headers.get("retry-after")
+    if retry_after is not None:
+        try:
+            return min(max(0.0, float(retry_after)), float(RATE_LIMIT_SLEEP_S))
+        except (TypeError, ValueError):
+            pass
+    reset = headers.get("x-ratelimit-reset")
+    if reset is not None:
+        try:
+            return min(max(0.0, float(reset) - now()), float(RATE_LIMIT_SLEEP_S))
+        except (TypeError, ValueError):
+            pass
+    return float(RATE_LIMIT_SLEEP_S)
 
 
 def _epoch(iso: str | float | None) -> float:
@@ -153,6 +208,7 @@ class GitHubCrawler:
         self.concurrency = concurrency
         self.stats = CrawlStats()
         self._rng = random.Random(seed)
+        self._backoff_rng = random.Random(seed + 1)  # jitter stream, lock-free
         # _request runs on the page-fetch pool: stats increments and the
         # shared rng need a lock (Python += is not atomic).
         self._lock = threading.Lock()
@@ -160,24 +216,56 @@ class GitHubCrawler:
 
     # --- request core (:50-68) ----------------------------------------------
 
+    def _call_transport(self, path: str, params: dict[str, Any], token: str | None):
+        """Invoke the transport; normalize 2-tuple (status, data) and
+        3-tuple (status, data, headers) returns (back-compat with every
+        existing fake transport)."""
+        _TRANSPORT_FAULT.hit()
+        out = self.transport(path, params, token)
+        if len(out) == 2:
+            status, data = out
+            return int(status), data, {}
+        status, data, headers = out
+        return int(status), data, dict(headers or {})
+
     def _request(self, path: str, params: dict[str, Any] | None = None) -> Any:
         params = params or {}
-        for _attempt in range(MAX_RETRIES):
+
+        def attempt():
             with self._lock:
                 token = self._rng.choice(self.tokens)
                 self.stats.requests += 1
-            status, data = self.transport(path, params, token or None)
+            status, data, headers = self._call_transport(path, params, token or None)
             if status == 200:
                 return data
-            if status == 403:  # rate limited -> sleep it out and retry
-                with self._lock:
-                    self.stats.rate_limit_sleeps += 1
-                self.sleeper(RATE_LIMIT_SLEEP_S)
-                continue
             if status == 404:
                 return None
-            self.sleeper(1.0)
-        raise RateLimited(f"giving up on {path} after {MAX_RETRIES} attempts")
+            if status in (403, 429):  # rate limited: server-directed wait
+                raise RetryAfter(rate_limit_delay(headers), f"HTTP {status} on {path}")
+            raise TransientHTTPError(status, path)
+
+        def on_retry(_attempt: int, exc: BaseException, delay: float) -> None:
+            # Count rate-limit waits where the sleep actually happens — a 403
+            # on the final attempt (no sleep, give up) and a zero-delay
+            # Retry-After must not inflate it.
+            if isinstance(exc, RetryAfter) and delay > 0:
+                with self._lock:
+                    self.stats.rate_limit_sleeps += 1
+
+        try:
+            return retry_call(
+                attempt,
+                policy=TRANSIENT_POLICY,
+                retry_on=lambda e: isinstance(e, (TransientHTTPError, OSError)),
+                site="crawler.request",
+                sleeper=self.sleeper,
+                rng=self._backoff_rng,
+                on_retry=on_retry,
+            )
+        except RetriesExhausted as e:
+            raise RateLimited(
+                f"giving up on {path} after {e.attempts} attempts"
+            ) from e.last
 
     def _fetch_pages(self, path: str, fetch_more: bool = True) -> list[Any]:
         """Paginated fetch on a thread pool (:85-101). Stops at the first

@@ -9,15 +9,30 @@ def pow2_at_least(n: int) -> int:
 
 
 from albedo_tpu.utils.checkpoint import (  # noqa: E402
+    Preempted,
+    PreemptionHandler,
     StepCheckpointer,
     checkpointed_als_fit,
     restore_pytree,
     save_pytree,
 )
+from albedo_tpu.utils.faults import FaultInjected
 from albedo_tpu.utils.profiling import Timer, profiler_trace, timed, timing
+from albedo_tpu.utils.retry import (
+    RetriesExhausted,
+    RetryAfter,
+    RetryPolicy,
+    retry_call,
+)
 from albedo_tpu.utils.schema import assert_columns, equals_ignore_nullability
 
 __all__ = [
+    "FaultInjected",
+    "Preempted",
+    "PreemptionHandler",
+    "RetriesExhausted",
+    "RetryAfter",
+    "RetryPolicy",
     "StepCheckpointer",
     "Timer",
     "pow2_at_least",
@@ -26,6 +41,7 @@ __all__ = [
     "equals_ignore_nullability",
     "profiler_trace",
     "restore_pytree",
+    "retry_call",
     "save_pytree",
     "timed",
     "timing",

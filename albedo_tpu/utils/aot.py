@@ -152,6 +152,12 @@ def persistent_aot_executable(
     """
     import jax
 
+    from albedo_tpu.utils.compilation_cache import harden_jax_cache_writes
+
+    # About to compile (and possibly persist the executable): make sure the
+    # persistent cache's writes are torn-write-safe first (idempotent).
+    harden_jax_cache_writes()
+
     dyn_kwargs = dict(dyn_kwargs or {})
     static_kwargs = dict(static_kwargs or {})
     digest = signature_digest(key_parts)

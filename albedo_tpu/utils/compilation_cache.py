@@ -12,6 +12,15 @@ faster on a toy program; the full LR executable drops from ~47 s to ~0).
 Disable with ``ALBEDO_JAX_CACHE=0``; the default directory lives beside the
 artifact store (``ALBEDO_DATA_DIR``), so ``drop_data``-style cleanup removes
 both.
+
+Preemption hardening: jax 0.4.x's on-disk cache writes entries with a bare
+``write_bytes`` — a process killed mid-write (pod preemption, the fault
+harness's ``kill`` action) leaves a TRUNCATED serialized executable that a
+later process happily deserializes. :func:`harden_jax_cache_writes` patches
+the write to the tmp + ``os.replace`` protocol every other artifact in this
+repo already uses, closing the torn-write window; stale tmp files from a
+killed writer are swept when the cache is enabled. The patch is best-effort
+and version-guarded: unrecognized jax internals leave jax untouched.
 """
 
 from __future__ import annotations
@@ -20,6 +29,84 @@ import os
 from pathlib import Path
 
 _ENABLED = False
+_PATCHED = False
+
+
+def harden_jax_cache_writes() -> bool:
+    """Make jax's persistent-compilation-cache writes atomic (idempotent).
+
+    Returns True when the patch is active. Call sites are anywhere jax is
+    already imported and about to compile (``utils.aot``, the CLI after
+    ``init_distributed``); before jax is imported there is nothing to patch.
+    """
+    global _PATCHED
+    if _PATCHED:
+        return True
+    try:
+        from jax._src import lru_cache as _lc
+
+        cls = _lc.LRUCache
+        orig_put = cls.put
+        cache_suffix = _lc._CACHE_SUFFIX
+        atime_suffix = _lc._ATIME_SUFFIX
+    except Exception:  # noqa: BLE001 — unknown jax internals: leave stock
+        return False
+    import time as _time
+
+    def _atomic_put(self, key: str, val: bytes) -> None:
+        if self.eviction_enabled and len(val) > self.max_size:
+            orig_put(self, key, val)  # keep jax's too-large warning path
+            return
+        cache_path = self.path / f"{key}{cache_suffix}"
+        atime_path = self.path / f"{key}{atime_suffix}"
+        if self.eviction_enabled:
+            self.lock.acquire(timeout=self.lock_timeout_secs)
+        try:
+            if cache_path.exists():
+                return
+            self._evict_if_needed(additional_size=len(val))
+            tmp = self.path / f"{key}.albedo-tmp-{os.getpid()}"
+            tmp.write_bytes(val)
+            os.replace(tmp, cache_path)  # a kill leaves tmp, never a torn entry
+            atime_path.write_bytes(_time.time_ns().to_bytes(8, "little"))
+        finally:
+            if self.eviction_enabled:
+                self.lock.release()
+
+    def put(self, key: str, val: bytes) -> None:
+        if not key:
+            raise ValueError("key cannot be empty")
+        try:
+            _atomic_put(self, key, val)
+        except (AttributeError, TypeError, FileNotFoundError):
+            # Internals drifted, or a concurrent sweep raced our tmp file:
+            # fall back to jax's stock write rather than failing the compile.
+            orig_put(self, key, val)
+
+    cls.put = put
+    _PATCHED = True
+    return True
+
+
+def _sweep_stale_tmp(cache_dir: Path, max_age_s: float = 3600.0) -> None:
+    """Remove tmp files a killed writer left behind (best-effort).
+
+    Age-gated: a tmp file younger than ``max_age_s`` may belong to a LIVE
+    writer in another process (compose `serve` warming while a trainer
+    runs) — deleting it mid-write would break that writer's os.replace.
+    """
+    import time as _time
+
+    now = _time.time()
+    try:
+        for p in Path(cache_dir).glob("*.albedo-tmp-*"):
+            try:
+                if now - p.stat().st_mtime >= max_age_s:
+                    p.unlink(missing_ok=True)
+            except OSError:
+                continue
+    except OSError:
+        pass
 
 
 def enable_persistent_compilation_cache(cache_dir: str | Path | None = None) -> bool:
@@ -32,6 +119,13 @@ def enable_persistent_compilation_cache(cache_dir: str | Path | None = None) -> 
     global _ENABLED
     if os.environ.get("ALBEDO_JAX_CACHE", "1") == "0":
         return False
+    import sys as _sys
+
+    if "jax" in _sys.modules:
+        # Re-invocations after jax lands still apply the atomic-write patch
+        # (the first call usually runs pre-import, where there is nothing
+        # to patch).
+        harden_jax_cache_writes()
     if _ENABLED:
         return True
     if cache_dir is None:
@@ -45,6 +139,7 @@ def enable_persistent_compilation_cache(cache_dir: str | Path | None = None) -> 
         # it): configure via env vars, which jax reads at import — the call
         # stays free of the multi-second jax import.
         Path(cache_dir).mkdir(parents=True, exist_ok=True)
+        _sweep_stale_tmp(Path(cache_dir))
         os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", str(cache_dir))
         os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "2")
         _ENABLED = True
@@ -55,6 +150,7 @@ def enable_persistent_compilation_cache(cache_dir: str | Path | None = None) -> 
         _ENABLED = True
         return True
     Path(cache_dir).mkdir(parents=True, exist_ok=True)
+    _sweep_stale_tmp(Path(cache_dir))
     jax.config.update("jax_compilation_cache_dir", str(cache_dir))
     # Executables this small recompile faster than they deserialize; only
     # persist genuinely expensive compiles.

@@ -1,0 +1,33 @@
+"""Atomic JSON persistence: the tmp-write + rename idiom, once.
+
+Every journal/manifest in the fault-tolerance layer (artifact manifests,
+checkpoint fit journals, the run_pipeline stage journal) persists small JSON
+through the same two primitives, so a kill can leave a stale ``*.tmp`` but
+never a torn document, and hardening (e.g. fsync-before-rename) has exactly
+one place to land.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Any
+
+
+def atomic_write_json(path: str | Path, obj: Any, *, indent: int | None = None) -> Path:
+    """Serialize ``obj`` to ``path`` via tmp + rename (same-directory, so the
+    rename is atomic on POSIX)."""
+    path = Path(path)
+    tmp = path.with_name(path.name + ".tmp")
+    tmp.write_text(json.dumps(obj, indent=indent, sort_keys=True))
+    tmp.rename(path)
+    return path
+
+
+def read_json_or_none(path: str | Path) -> Any | None:
+    """Parse ``path`` as JSON; a missing or undecodable file is None, never a
+    crash (resume paths treat both as 'no journal')."""
+    try:
+        return json.loads(Path(path).read_text())
+    except (OSError, ValueError):
+        return None

@@ -1,0 +1,124 @@
+"""Shared retry policy: exponential backoff with full jitter + deadline budget.
+
+One policy object serves every layer that talks to something flaky — the
+GitHub crawler's transport, artifact IO, and the ``run_pipeline`` stage
+driver — replacing the per-site fixed sleeps (``sleep(1800)``/``sleep(1.0)``)
+the seed hard-coded. Full jitter (delay ~ U(0, min(cap, base * mult^n)))
+follows the AWS architecture-blog result ALX-style preemptible fleets rely
+on: synchronized retry storms after a shared outage are worse than the
+failure itself.
+
+Servers that SAY when to come back are honored exactly: raise
+:class:`RetryAfter` from the attempt (the crawler does, from the GitHub
+``Retry-After`` / ``X-RateLimit-Reset`` headers) and the wait is the server's
+number, not the backoff curve's. Every performed retry is counted in the
+process-global ``albedo_retry_attempts_total{site=...}`` counter
+(``utils.events``) so `/metrics` shows which dependency is flapping.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+import time
+from typing import Any, Callable
+
+from albedo_tpu.utils import events
+
+
+class RetryAfter(Exception):
+    """An attempt failed but the server supplied the wait: honor it.
+
+    ``delay_s`` overrides the backoff curve for this one retry (still clipped
+    to the policy's remaining deadline). Raised by callers' attempt
+    functions; never raised by this module.
+    """
+
+    def __init__(self, delay_s: float, message: str = ""):
+        super().__init__(message or f"retry after {delay_s:g}s")
+        self.delay_s = max(0.0, float(delay_s))
+
+
+class RetriesExhausted(Exception):
+    """All attempts failed (or the deadline expired). ``__cause__`` is the
+    last attempt's exception; ``attempts`` is how many were made."""
+
+    def __init__(self, site: str, attempts: int, last: BaseException):
+        super().__init__(f"{site}: giving up after {attempts} attempts: {last!r}")
+        self.site = site
+        self.attempts = attempts
+        self.last = last
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """Backoff schedule + stop conditions (immutable, shareable).
+
+    ``max_attempts`` counts TOTAL attempts (first try included);
+    ``deadline_s`` caps wall-clock across attempts AND sleeps — a retry whose
+    jittered delay would overshoot the deadline sleeps only the remainder,
+    gets one last attempt, and then gives up.
+    """
+
+    max_attempts: int = 5
+    base_s: float = 0.5
+    multiplier: float = 2.0
+    max_delay_s: float = 60.0
+    deadline_s: float | None = None
+    jitter: bool = True  # full jitter; False = deterministic caps (tests)
+
+    def delay(self, attempt: int, rng: random.Random) -> float:
+        """Backoff delay after the ``attempt``-th failure (0-based)."""
+        cap = min(self.max_delay_s, self.base_s * (self.multiplier ** attempt))
+        return rng.uniform(0.0, cap) if self.jitter else cap
+
+
+def retry_call(
+    fn: Callable[[], Any],
+    *,
+    policy: RetryPolicy | None = None,
+    retry_on: Callable[[BaseException], bool] | None = None,
+    site: str = "call",
+    sleeper: Callable[[float], None] = time.sleep,
+    rng: random.Random | None = None,
+    clock: Callable[[], float] = time.monotonic,
+    on_retry: Callable[[int, BaseException, float], None] | None = None,
+) -> Any:
+    """Call ``fn()`` until it returns, the predicate rejects, or budget ends.
+
+    - ``retry_on(exc)`` decides retryability (default: any Exception);
+      non-retryable exceptions propagate unchanged. :class:`RetryAfter` is
+      always retryable and carries its own delay.
+    - ``on_retry(attempt, exc, delay_s)`` observes each scheduled retry.
+    - Exhaustion raises :class:`RetriesExhausted` from the last exception.
+
+    ``sleeper``/``rng``/``clock`` are injectable for deterministic tests.
+    """
+    policy = policy or RetryPolicy()
+    rng = rng or random.Random()
+    start = clock()
+    last: BaseException | None = None
+    for attempt in range(max(1, policy.max_attempts)):
+        try:
+            return fn()
+        except RetryAfter as e:
+            last = e
+            delay = e.delay_s
+        except Exception as e:  # noqa: BLE001 — predicate decides
+            if retry_on is not None and not retry_on(e):
+                raise
+            last = e
+            delay = policy.delay(attempt, rng)
+        if attempt + 1 >= policy.max_attempts:
+            break
+        if policy.deadline_s is not None:
+            remaining = policy.deadline_s - (clock() - start)
+            if remaining <= 0:
+                break
+            delay = min(delay, remaining)
+        events.retry_attempts.inc(site=site)
+        if on_retry is not None:
+            on_retry(attempt, last, delay)
+        if delay > 0:
+            sleeper(delay)
+    raise RetriesExhausted(site, min(policy.max_attempts, attempt + 1), last) from last

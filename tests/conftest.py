@@ -33,6 +33,19 @@ def rng():
 
 
 @pytest.fixture(autouse=True)
+def _reset_fault_registry():
+    """No armed fault, hit counter, or global event count leaks between
+    tests."""
+    from albedo_tpu.utils import events, faults
+
+    faults.reset()
+    events.reset_global_metrics()
+    yield
+    faults.reset()
+    events.reset_global_metrics()
+
+
+@pytest.fixture(autouse=True)
 def _isolated_artifact_dir(tmp_path, monkeypatch):
     """Point the artifact store at a per-test temp dir."""
     monkeypatch.setenv("ALBEDO_DATA_DIR", str(tmp_path / "albedo-data"))

@@ -1,0 +1,141 @@
+"""Artifact store integrity: checksum manifests, quarantine-and-regenerate,
+corruption counters, and fault-site-driven chaos."""
+
+import json
+import pickle
+
+import pytest
+
+from albedo_tpu.datasets import artifacts
+from albedo_tpu.datasets.artifacts import (
+    artifact_path,
+    load_or_create_json,
+    load_or_create_pickle,
+    manifest_path,
+    quarantine,
+    verify_manifest,
+    write_manifest,
+)
+from albedo_tpu.utils import events, faults
+
+
+def test_write_leaves_manifest_and_load_hits_cache():
+    calls = []
+
+    def create():
+        calls.append(1)
+        return {"x": [1, 2, 3]}
+
+    v1 = load_or_create_pickle("thing.pkl", create)
+    path = artifact_path("thing.pkl")
+    assert path.exists() and manifest_path(path).exists()
+    manifest = json.loads(manifest_path(path).read_text())
+    assert manifest["size"] == path.stat().st_size
+    v2 = load_or_create_pickle("thing.pkl", create)
+    assert v1 == v2 and len(calls) == 1  # second call was a cache hit
+
+
+def test_bit_flip_quarantines_and_regenerates():
+    calls = []
+
+    def create():
+        calls.append(1)
+        return {"payload": "value-%d" % len(calls)}
+
+    load_or_create_pickle("flip.pkl", create)
+    path = artifact_path("flip.pkl")
+    # Bit-flip through the fault site, exactly as a chaos run would.
+    faults.arm("artifact.load", kind="corrupt", at=1)
+    before = events.artifact_corruptions.value(artifact="flip.pkl")
+    out = load_or_create_pickle("flip.pkl", create)
+    # Regenerated (not crashed), original quarantined with its manifest.
+    assert out == {"payload": "value-2"} and len(calls) == 2
+    corrupt = path.with_name("flip.pkl.corrupt-1")
+    assert corrupt.exists()
+    assert corrupt.with_name(corrupt.name + ".sha256").exists()
+    assert events.artifact_corruptions.value(artifact="flip.pkl") == before + 1
+    # The regenerated slot is healthy: next load is a clean cache hit.
+    assert load_or_create_pickle("flip.pkl", create) == {"payload": "value-2"}
+    assert len(calls) == 2
+
+
+def test_truncated_artifact_regenerates():
+    load_or_create_pickle("trunc.pkl", lambda: list(range(100)))
+    path = artifact_path("trunc.pkl")
+    path.write_bytes(path.read_bytes()[: path.stat().st_size // 2])
+    out = load_or_create_pickle("trunc.pkl", lambda: "fresh")
+    assert out == "fresh"
+    assert path.with_name("trunc.pkl.corrupt-1").exists()
+
+
+def test_unpicklable_garbage_regenerates_via_load_error():
+    """No manifest at all (pre-manifest artifact) + undecodable content:
+    the raising load quarantines instead of crashing."""
+    path = artifact_path("legacy.pkl")
+    path.write_bytes(b"not a pickle at all")
+    assert not manifest_path(path).exists()
+    out = load_or_create_pickle("legacy.pkl", lambda: 42)
+    assert out == 42
+    assert path.with_name("legacy.pkl.corrupt-1").exists()
+    # The regenerated artifact now has a manifest.
+    assert manifest_path(path).exists()
+
+
+def test_repeated_corruption_numbers_quarantines():
+    for round_no in (1, 2):
+        load_or_create_pickle("multi.pkl", lambda: "v")
+        path = artifact_path("multi.pkl")
+        data = bytearray(path.read_bytes())
+        data[0] ^= 0xFF
+        path.write_bytes(bytes(data))
+        load_or_create_pickle("multi.pkl", lambda: "v")
+    base = artifact_path("multi.pkl")
+    assert base.with_name("multi.pkl.corrupt-1").exists()
+    assert base.with_name("multi.pkl.corrupt-2").exists()
+
+
+def test_verify_manifest_states(tmp_path):
+    p = tmp_path / "f.bin"
+    p.write_bytes(b"hello")
+    assert verify_manifest(p) is None  # no manifest yet
+    write_manifest(p)
+    assert verify_manifest(p) is True
+    p.write_bytes(b"hellO")
+    assert verify_manifest(p) is False
+
+
+def test_quarantine_moves_file_and_manifest(tmp_path):
+    p = tmp_path / "a.pkl"
+    p.write_bytes(pickle.dumps(1))
+    write_manifest(p)
+    dest = quarantine(p, reason="test")
+    assert not p.exists() and dest.exists()
+    assert dest.name == "a.pkl.corrupt-1"
+    assert dest.with_name(dest.name + ".sha256").exists()
+
+
+def test_save_ioerror_fault_propagates():
+    """IO faults at artifact.save are NOT swallowed — a failed write must
+    fail the job (the tmp+rename protocol means no bad artifact remains)."""
+    faults.arm("artifact.save", kind="ioerror")
+    with pytest.raises(OSError):
+        load_or_create_json("doomed.json", lambda: {"a": 1})
+    assert not artifact_path("doomed.json").exists()
+
+
+def test_json_roundtrip_keeps_manifest_valid():
+    v = load_or_create_json("meta.json", lambda: {"k": [1, 2]})
+    path = artifact_path("meta.json")
+    assert verify_manifest(path) is True
+    assert v == {"k": [1, 2]}
+    assert load_or_create_json("meta.json", lambda: {"k": []}) == {"k": [1, 2]}
+
+
+def test_dir_hash_covers_member_names(tmp_path):
+    d = tmp_path / "art"
+    (d / "sub").mkdir(parents=True)
+    (d / "a.bin").write_bytes(b"aa")
+    (d / "sub" / "b.bin").write_bytes(b"bb")
+    h1 = artifacts.file_sha256(d)
+    (d / "a.bin").rename(d / "c.bin")
+    assert artifacts.file_sha256(d) != h1  # rename changes the digest

@@ -1,0 +1,87 @@
+"""End-to-end kill/resume chaos through the real CLI, in subprocesses.
+
+These are the acceptance-grade preemption drills: a ``train_als`` run is
+actually killed (SIGKILL via the fault harness's ``kill`` action — exit 137,
+no cleanup) or preempted (SIGTERM via ``term`` — checkpoint + exit 75), then
+rerun with ``--resume``; the resumed run must finish from the surviving
+checkpoints and match the uninterrupted run's NDCG@30 within 1e-3.
+
+Marked ``chaos`` (the ``make chaos`` suite) and ``slow`` (three CLI
+subprocesses each pay the jax import + compile): tier-1 covers the same
+parity logic in-process in ``test_checkpoint.py::test_kill_resume_ndcg_parity``.
+"""
+
+import os
+import re
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+pytestmark = [pytest.mark.chaos, pytest.mark.slow]
+
+_NDCG_RE = re.compile(r"\[train_als\] NDCG@30 = ([0-9.eE+-]+)")
+
+
+def _env(data_dir: Path, **extra: str) -> dict:
+    env = dict(os.environ)
+    env.pop("ALBEDO_FAULTS", None)  # never inherit the harness's own arming
+    env.update(
+        ALBEDO_DATA_DIR=str(data_dir),
+        ALBEDO_CHECKPOINT_DIR=str(data_dir / "checkpoints"),
+        ALBEDO_TODAY="20260803",
+        JAX_PLATFORMS="cpu",
+        **extra,
+    )
+    return env
+
+
+def _train_als(env: dict, *extra_args: str) -> subprocess.CompletedProcess:
+    # --no-compilation-cache: the parity assertion below is exact-determinism
+    # grade, and serialized-executable reuse on this jaxlib/CPU combination
+    # introduces sub-1e-3 numeric drift between processes that would blur it.
+    cmd = [
+        sys.executable, "-m", "albedo_tpu.cli", "train_als", "--small",
+        "--checkpoint-every", "2", "--no-compilation-cache", *extra_args,
+    ]
+    return subprocess.run(cmd, capture_output=True, text=True, env=env, timeout=580)
+
+def _ndcg(proc: subprocess.CompletedProcess) -> float:
+    m = _NDCG_RE.search(proc.stdout)
+    assert m, f"no NDCG in output:\n{proc.stdout}\n{proc.stderr}"
+    return float(m.group(1))
+
+
+def test_sigkill_then_resume_matches_uninterrupted_ndcg(tmp_path):
+    # Reference: uninterrupted checkpointed run in its own data dir.
+    ref = _train_als(_env(tmp_path / "ref"))
+    assert ref.returncode == 0, ref.stderr
+    ndcg_ref = _ndcg(ref)
+
+    # Chaos run: hard-killed (os._exit(137)) right after the 2nd checkpoint.
+    env = _env(tmp_path / "data")
+    killed = _train_als({**env, "ALBEDO_FAULTS": "checkpoint.save:kill@2"})
+    assert killed.returncode == 137, (killed.returncode, killed.stderr)
+    steps = sorted((tmp_path / "data/checkpoints").rglob("step_*"))
+    assert steps, "the killed run left no checkpoints"
+
+    # Resume from the survivors; quality parity with the uninterrupted run.
+    resumed = _train_als(env, "--resume")
+    assert resumed.returncode == 0, resumed.stderr
+    assert abs(_ndcg(resumed) - ndcg_ref) <= 1e-3
+
+
+def test_sigterm_preempts_cleanly_and_resumes(tmp_path):
+    env = _env(tmp_path / "data")
+    # SIGTERM at the 1st checkpoint boundary: the preemption handler flags,
+    # the fit checkpoints, the CLI exits 75 (EX_TEMPFAIL) with a journal.
+    preempted = _train_als({**env, "ALBEDO_FAULTS": "checkpoint.save:term@1"})
+    assert preempted.returncode == 75, (preempted.returncode, preempted.stderr)
+    journals = list((tmp_path / "data/checkpoints").rglob("journal.json"))
+    assert journals and '"status": "preempted"' in journals[0].read_text()
+
+    resumed = _train_als(env, "--resume")
+    assert resumed.returncode == 0, resumed.stderr
+    assert _ndcg(resumed) > 0
+    assert '"status": "complete"' in journals[0].read_text()

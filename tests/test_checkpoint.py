@@ -1,5 +1,7 @@
 """Orbax checkpointing: pytree roundtrip, step management, and resumable ALS
-training (kill mid-train, resume from latest, reach the same quality)."""
+training (kill mid-train, resume from latest, reach the same quality) — plus
+the fault-tolerance layer: garbage step dirs, corrupt-step fallback,
+retention pruning, preemption handling, and kill-resume NDCG parity."""
 
 import numpy as np
 import pytest
@@ -8,8 +10,16 @@ jax = pytest.importorskip("jax")
 pytest.importorskip("orbax.checkpoint")
 
 from albedo_tpu.datasets import synthetic_stars  # noqa: E402
+from albedo_tpu.evaluators import (  # noqa: E402
+    RankingEvaluator,
+    UserItems,
+    user_actual_items,
+)
 from albedo_tpu.models.als import ImplicitALS  # noqa: E402
+from albedo_tpu.utils import events, faults  # noqa: E402
 from albedo_tpu.utils.checkpoint import (  # noqa: E402
+    Preempted,
+    PreemptionHandler,
     StepCheckpointer,
     checkpointed_als_fit,
     restore_pytree,
@@ -63,3 +73,160 @@ def test_checkpointed_als_resume(tmp_path):
     # A fit already at max_iter restores without retraining.
     again = checkpointed_als_fit(als, m, partial_dir, every=2)
     np.testing.assert_allclose(again.user_factors, resumed.user_factors, rtol=1e-6)
+
+
+# --- fault tolerance ---------------------------------------------------------
+
+
+def test_steps_skips_garbage_dirs(tmp_path):
+    """Leftover Orbax temp dirs, stray files, and half-created (empty) step
+    dirs must be invisible — not crash steps()/restore_latest()."""
+    ckpt = StepCheckpointer(tmp_path / "steps")
+    ckpt.save(2, {"x": np.ones(3)})
+    # Plant the garbage a preempted writer leaves behind.
+    (tmp_path / "steps" / "step_00000004.orbax-checkpoint-tmp-99").mkdir()
+    (tmp_path / "steps" / "step_00000004.orbax-checkpoint-tmp-99" / "d").write_bytes(b"x")
+    (tmp_path / "steps" / "step_00000006").mkdir()  # mkdir happened, write didn't
+    (tmp_path / "steps" / "step_garbage").mkdir()
+    (tmp_path / "steps" / "step_00000008x").mkdir()
+    (tmp_path / "steps" / "not_a_step.txt").write_text("hi")
+    assert ckpt.steps() == [2]
+    step, tree = ckpt.restore_latest()
+    assert step == 2
+    np.testing.assert_array_equal(tree["x"], np.ones(3))
+
+
+def test_restore_latest_falls_back_to_newest_readable(tmp_path):
+    ckpt = StepCheckpointer(tmp_path / "steps")
+    ckpt.save(2, {"x": np.full(3, 2.0)})
+    ckpt.save(4, {"x": np.full(3, 4.0)})
+    # Corrupt the newest step's payload: checksum verification catches it.
+    target = sorted(
+        p for p in (tmp_path / "steps" / "step_00000004").rglob("*") if p.is_file()
+    )[0]
+    data = bytearray(target.read_bytes())
+    data[len(data) // 2] ^= 0xFF
+    target.write_bytes(bytes(data))
+
+    before = events.checkpoint_fallbacks.total()
+    step, tree = ckpt.restore_latest()
+    assert step == 2
+    np.testing.assert_array_equal(tree["x"], np.full(3, 2.0))
+    assert events.checkpoint_fallbacks.total() == before + 1
+
+
+def test_restore_latest_survives_unreadable_step_without_manifest(tmp_path):
+    """A step dir whose manifest is gone AND whose contents are trash (the
+    pre-manifest seed bug: restore_latest crashed) falls back."""
+    ckpt = StepCheckpointer(tmp_path / "steps")
+    ckpt.save(2, {"x": np.ones(2)})
+    bad = tmp_path / "steps" / "step_00000009"
+    bad.mkdir()
+    (bad / "checkpoint").write_bytes(b"not an orbax checkpoint")
+    step, _ = ckpt.restore_latest()
+    assert step == 2
+
+
+def test_restore_latest_all_unreadable_returns_none(tmp_path):
+    ckpt = StepCheckpointer(tmp_path / "steps")
+    bad = tmp_path / "steps" / "step_00000003"
+    bad.mkdir()
+    (bad / "checkpoint").write_bytes(b"junk")
+    assert ckpt.restore_latest() is None
+
+
+def test_retention_pruning(tmp_path):
+    ckpt = StepCheckpointer(tmp_path / "steps", keep_last=2)
+    for step in (2, 4, 6, 8):
+        ckpt.save(step, {"x": np.full(2, float(step))})
+    assert ckpt.steps() == [6, 8]
+    # Manifests pruned alongside their steps.
+    leftovers = sorted(p.name for p in (tmp_path / "steps").glob("step_*.sha256"))
+    assert leftovers == ["step_00000006.sha256", "step_00000008.sha256"]
+    step, tree = ckpt.restore_latest()
+    assert step == 8
+
+
+def test_corrupt_fault_site_on_save_is_caught_on_restore(tmp_path):
+    faults.arm("checkpoint.save", kind="corrupt", at=2)
+    ckpt = StepCheckpointer(tmp_path / "steps")
+    ckpt.save(2, {"x": np.ones(2)})
+    ckpt.save(4, {"x": np.full(2, 4.0)})  # corrupted before its manifest
+    # The manifest hashed the corrupted bytes, so verify passes — but orbax
+    # restore fails on the flipped payload and the walk falls back to step 2.
+    step, _ = ckpt.restore_latest()
+    assert step in (2, 4)  # depending on which file the flip hit
+    if step == 4:
+        # If orbax tolerated the flip (metadata file), the restore is still
+        # self-consistent; nothing to assert beyond not crashing.
+        return
+    np.testing.assert_array_equal(ckpt.restore(2)["x"], np.ones(2))
+
+
+def test_checkpoint_interval_must_be_positive(tmp_path):
+    m = synthetic_stars(n_users=40, n_items=30, mean_stars=5, seed=2)
+    als = ImplicitALS(rank=4, max_iter=4, seed=1)
+    with pytest.raises(ValueError, match="interval"):
+        checkpointed_als_fit(als, m, tmp_path / "bad", every=0)
+
+
+def test_preemption_checkpoint_and_resume(tmp_path):
+    m = synthetic_stars(n_users=120, n_items=70, mean_stars=8, seed=3)
+    als = ImplicitALS(rank=8, reg_param=0.3, alpha=10.0, max_iter=6, seed=1)
+    handler = PreemptionHandler()
+    handler.request_stop()  # as if SIGTERM arrived during the first chunk
+    with pytest.raises(Preempted) as ei:
+        checkpointed_als_fit(als, m, tmp_path / "pre", every=2, preemption=handler)
+    assert ei.value.step == 2
+    ckpt = StepCheckpointer(tmp_path / "pre")
+    assert ckpt.steps() == [2]
+    assert ckpt.read_journal()["status"] == "preempted"
+
+    # Resume (no preemption this time) finishes and journals completion.
+    model = checkpointed_als_fit(als, m, tmp_path / "pre", every=2)
+    assert ckpt.latest_step() == 6
+    assert ckpt.read_journal()["status"] == "complete"
+    assert model.user_factors.shape == (m.n_users, 8)
+
+
+def test_preemption_handler_installs_and_restores_signal(tmp_path):
+    import signal as _signal
+
+    prev = _signal.getsignal(_signal.SIGTERM)
+    with PreemptionHandler() as h:
+        assert not h.should_stop()
+        _signal.raise_signal(_signal.SIGTERM)
+        assert h.should_stop()
+    assert _signal.getsignal(_signal.SIGTERM) is prev
+
+
+def _ndcg30(model, matrix) -> float:
+    users = np.arange(min(100, matrix.n_users), dtype=np.int64)
+    _, idx = model.recommend(users, k=30)
+    predicted = UserItems(users=users, items=idx.astype(np.int32))
+    return RankingEvaluator(metric_name="ndcg@k", k=30).evaluate(
+        predicted, user_actual_items(matrix, k=30)
+    )
+
+
+def test_kill_resume_ndcg_parity(tmp_path):
+    """Acceptance: a fit killed mid-train (fault harness, at a checkpoint
+    boundary) and rerun with resume matches the uninterrupted run's NDCG@30
+    within 1e-3."""
+    m = synthetic_stars(n_users=150, n_items=90, mean_stars=10, seed=6)
+    als = ImplicitALS(rank=8, reg_param=0.3, alpha=10.0, max_iter=6, seed=4)
+
+    full = checkpointed_als_fit(als, m, tmp_path / "full", every=2)
+    ndcg_full = _ndcg30(full, m)
+
+    # Kill the run via the fault harness right after the 2nd checkpoint.
+    faults.arm("checkpoint.save", kind="error", at=2)
+    with pytest.raises(faults.FaultInjected):
+        checkpointed_als_fit(als, m, tmp_path / "killed", every=2)
+    faults.disarm("checkpoint.save")
+    assert StepCheckpointer(tmp_path / "killed").steps() == [2, 4]
+
+    resumed = checkpointed_als_fit(als, m, tmp_path / "killed", every=2)
+    ndcg_resumed = _ndcg30(resumed, m)
+    assert abs(ndcg_resumed - ndcg_full) <= 1e-3
+    assert ndcg_full > 0  # the metric is non-degenerate

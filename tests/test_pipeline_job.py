@@ -1,0 +1,174 @@
+"""run_pipeline: the one-command offline chain — journal records, resume
+skipping, per-stage retry, failure journaling, and corrupt-artifact
+self-healing."""
+
+import argparse
+
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from albedo_tpu.builders.jobs import JobContext  # noqa: E402
+from albedo_tpu.builders.pipeline import (  # noqa: E402
+    JOURNAL_NAME,
+    STAGES,
+    PipelineStageFailed,
+    load_journal,
+    run_pipeline,
+)
+from albedo_tpu.datasets import synthetic_tables  # noqa: E402
+from albedo_tpu.datasets.artifacts import artifact_path  # noqa: E402
+from albedo_tpu.utils import events, faults  # noqa: E402
+
+_NOSLEEP = dict(sleeper=lambda s: None, verbose=False)
+
+
+def make_ctx():
+    ns = argparse.Namespace(
+        small=True, tables=None, now=1700000000.0, no_compilation_cache=True
+    )
+    tables = synthetic_tables(n_users=120, n_items=80, mean_stars=10, seed=11)
+    return JobContext(ns, tables=tables, tag="pipetest")
+
+
+def journal_on_disk(ctx) -> dict:
+    return load_journal(artifact_path(ctx.artifact_name(JOURNAL_NAME)))
+
+
+def test_job_is_registered():
+    import albedo_tpu.builders  # noqa: F401  (registers)
+    from albedo_tpu.cli import _JOBS
+
+    assert "run_pipeline" in _JOBS
+
+
+def test_full_chain_completes_and_journals():
+    ctx = make_ctx()
+    journal = run_pipeline(ctx, **_NOSLEEP)
+    assert journal["status"] == "complete"
+    assert set(journal["stages"]) == {n for n, _ in STAGES}
+    for name, record in journal["stages"].items():
+        assert record["status"] == "done", name
+        assert record["attempts"] == 1
+        assert record["finished_at"] >= record["started_at"]
+    # Stage results carry the chain's vitals.
+    assert journal["stages"]["popularity"]["result"]["rows"] > 0
+    assert journal["stages"]["train_als"]["result"]["rank"] == 16  # small config
+    assert journal["stages"]["train_lr"]["result"]["auc"] > 0.5
+    # Journal is persisted, and listed artifacts exist with manifests.
+    disk = journal_on_disk(ctx)
+    assert disk["status"] == "complete"
+    for record in disk["stages"].values():
+        for name in record["artifacts"]:
+            path = artifact_path(name)
+            assert path.exists()
+            assert path.with_name(path.name + ".sha256").exists()
+
+
+def test_resume_skips_completed_stages():
+    ctx = make_ctx()
+    stages = ["popularity", "user_profile"]
+    first = run_pipeline(ctx, stages=stages, **_NOSLEEP)
+    # Fresh context (new process analogue): resume must skip, not re-run.
+    second = run_pipeline(make_ctx(), resume=True, stages=stages, **_NOSLEEP)
+    for name in stages:
+        assert second["stages"][name]["status"] == "done"
+        # started_at unchanged == the stage body never re-ran.
+        assert second["stages"][name]["started_at"] == first["stages"][name]["started_at"]
+
+
+def test_stage_retries_through_transient_fault():
+    faults.arm("pipeline.stage.popularity", kind="error", at=1)  # fails once
+    journal = run_pipeline(make_ctx(), stages=["popularity"], **_NOSLEEP)
+    record = journal["stages"]["popularity"]
+    assert record["status"] == "done"
+    assert record["attempts"] == 2
+    assert events.retry_attempts.value(site="pipeline.popularity") >= 1
+
+
+def test_stage_failure_journals_and_resume_retries():
+    ctx = make_ctx()
+    faults.arm("pipeline.stage.repo_profile", kind="error", times=0)  # permanent
+    with pytest.raises(PipelineStageFailed) as ei:
+        run_pipeline(ctx, stages=["popularity", "repo_profile"],
+                     max_stage_attempts=2, **_NOSLEEP)
+    assert ei.value.stage == "repo_profile"
+    disk = journal_on_disk(ctx)
+    assert disk["status"] == "failed"
+    assert disk["stages"]["popularity"]["status"] == "done"
+    failed = disk["stages"]["repo_profile"]
+    assert failed["status"] == "failed"
+    assert failed["attempts"] == 2
+    assert "FaultInjected" in failed["error"]
+
+    # The outage ends; --resume completes the chain from where it stopped.
+    faults.disarm("pipeline.stage.repo_profile")
+    healed = run_pipeline(make_ctx(), resume=True,
+                          stages=["popularity", "repo_profile"], **_NOSLEEP)
+    assert healed["status"] == "partial"  # clean subset run, not the full chain
+    assert healed["stages"]["popularity"]["started_at"] == disk["stages"]["popularity"]["started_at"]
+    assert healed["stages"]["repo_profile"]["status"] == "done"
+
+
+def test_corrupted_artifact_heals_without_intervention():
+    """Acceptance: a bit-flipped artifact (fault site) is quarantined and
+    regenerated; the pipeline completes and the corruption is counted."""
+    ctx = make_ctx()
+    run_pipeline(ctx, stages=["popularity"], **_NOSLEEP)
+    name = ctx.artifact_name("popularRepoDF.parquet")
+
+    faults.arm("artifact.load", kind="corrupt", at=1)
+    before = events.artifact_corruptions.value(artifact=name)
+    journal = run_pipeline(make_ctx(), stages=["popularity"], **_NOSLEEP)
+    assert journal["status"] == "partial"  # clean, but a subset of the chain
+    assert journal["stages"]["popularity"]["status"] == "done"
+    assert events.artifact_corruptions.value(artifact=name) == before + 1
+    path = artifact_path(name)
+    assert path.exists()  # regenerated in place
+    assert path.with_name(name + ".corrupt-1").exists()  # evidence kept
+
+
+def test_stage_retry_resumes_from_own_checkpoints():
+    """A transient checkpoint-write failure mid-ALS must NOT make the stage
+    retry wipe the steps this very run saved and restart from iteration 0:
+    the retry resumes. Observable via checkpoint.save hit counts: --small
+    trains 8 iters every 2 (4 saves). The fault site fires AFTER the Orbax
+    write, so step 4's data survives the injected IOError and the retry
+    resumes from step 4 — 2 more saves, 4 hits total. A from-scratch restart
+    (the bug: rmtree on every attempt) would re-save all 4 steps: 6 hits."""
+    ctx = make_ctx()
+    ctx.args.checkpoint_every = 2
+    faults.arm("checkpoint.save", kind="ioerror", at=2)
+    journal = run_pipeline(ctx, stages=["train_als"], **_NOSLEEP)
+    assert journal["stages"]["train_als"]["status"] == "done"
+    assert journal["stages"]["train_als"]["attempts"] == 2
+    assert faults.FAULTS.hits("checkpoint.save") == 4
+
+
+def test_preempted_stage_propagates_without_retry(monkeypatch):
+    """A Preempted raised mid-stage is a scheduler notice, not a transient
+    failure: no retry (which would restart training under a dying pod), the
+    journal records 'preempted', and the exception reaches the CLI's
+    exit-75 mapping."""
+    from albedo_tpu.utils.checkpoint import Preempted
+
+    ctx = make_ctx()
+    calls = []
+
+    def fake_als_model():
+        calls.append(1)
+        raise Preempted(4)
+
+    monkeypatch.setattr(ctx, "als_model", fake_als_model)
+    with pytest.raises(Preempted):
+        run_pipeline(ctx, stages=["popularity", "train_als"], **_NOSLEEP)
+    assert len(calls) == 1  # exactly one attempt
+    disk = journal_on_disk(ctx)
+    assert disk["status"] == "preempted"
+    assert disk["stages"]["train_als"]["status"] == "preempted"
+    assert disk["stages"]["popularity"]["status"] == "done"
+
+
+def test_unknown_stage_rejected():
+    with pytest.raises(ValueError):
+        run_pipeline(make_ctx(), stages=["nope"], **_NOSLEEP)

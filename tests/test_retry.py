@@ -1,0 +1,144 @@
+"""Shared retry machinery: backoff shape, jitter bounds, deadline budget,
+predicates, Retry-After honoring, and the retry counter."""
+
+import random
+
+import pytest
+
+from albedo_tpu.utils import events
+from albedo_tpu.utils.retry import (
+    RetriesExhausted,
+    RetryAfter,
+    RetryPolicy,
+    retry_call,
+)
+
+
+def test_succeeds_after_transient_failures():
+    calls = []
+
+    def fn():
+        calls.append(1)
+        if len(calls) < 3:
+            raise ValueError("transient")
+        return "ok"
+
+    sleeps = []
+    out = retry_call(
+        fn,
+        policy=RetryPolicy(max_attempts=5, base_s=0.1, max_delay_s=1.0),
+        sleeper=sleeps.append,
+        rng=random.Random(0),
+    )
+    assert out == "ok"
+    assert len(calls) == 3
+    assert len(sleeps) == 2  # one sleep per retry, none after success
+
+
+def test_exhaustion_raises_with_cause():
+    def fn():
+        raise ValueError("always")
+
+    with pytest.raises(RetriesExhausted) as ei:
+        retry_call(
+            fn,
+            policy=RetryPolicy(max_attempts=3, base_s=0.0),
+            sleeper=lambda s: None,
+        )
+    assert ei.value.attempts == 3
+    assert isinstance(ei.value.last, ValueError)
+    assert isinstance(ei.value.__cause__, ValueError)
+
+
+def test_non_retryable_propagates_unchanged():
+    calls = []
+
+    def fn():
+        calls.append(1)
+        raise KeyError("fatal")
+
+    with pytest.raises(KeyError):
+        retry_call(
+            fn,
+            retry_on=lambda e: isinstance(e, ValueError),
+            sleeper=lambda s: None,
+        )
+    assert len(calls) == 1  # no second attempt for a non-retryable error
+
+
+def test_full_jitter_delays_bounded_by_exponential_caps():
+    policy = RetryPolicy(max_attempts=6, base_s=1.0, multiplier=2.0, max_delay_s=6.0)
+    sleeps = []
+
+    def fn():
+        raise ValueError("x")
+
+    with pytest.raises(RetriesExhausted):
+        retry_call(fn, policy=policy, sleeper=sleeps.append, rng=random.Random(7))
+    caps = [1.0, 2.0, 4.0, 6.0, 6.0]  # base * mult^n clipped at max_delay_s
+    assert len(sleeps) == 5
+    for delay, cap in zip(sleeps, caps):
+        assert 0.0 <= delay <= cap
+
+
+def test_no_jitter_uses_deterministic_caps():
+    policy = RetryPolicy(max_attempts=4, base_s=0.5, multiplier=2.0,
+                         max_delay_s=10.0, jitter=False)
+    sleeps = []
+
+    def fn():
+        raise ValueError("x")
+
+    with pytest.raises(RetriesExhausted):
+        retry_call(fn, policy=policy, sleeper=sleeps.append)
+    assert sleeps == [0.5, 1.0, 2.0]
+
+
+def test_retry_after_overrides_backoff():
+    calls = []
+
+    def fn():
+        calls.append(1)
+        if len(calls) == 1:
+            raise RetryAfter(123.0, "server says wait")
+        return "ok"
+
+    sleeps = []
+    assert retry_call(fn, sleeper=sleeps.append) == "ok"
+    assert sleeps == [123.0]
+
+
+def test_deadline_stops_retrying():
+    clock = {"t": 0.0}
+
+    def fake_clock():
+        return clock["t"]
+
+    def fake_sleep(s):
+        clock["t"] += s
+
+    def fn():
+        raise ValueError("x")
+
+    policy = RetryPolicy(max_attempts=100, base_s=1.0, multiplier=1.0,
+                         max_delay_s=1.0, deadline_s=3.5, jitter=False)
+    with pytest.raises(RetriesExhausted) as ei:
+        retry_call(fn, policy=policy, sleeper=fake_sleep, clock=fake_clock)
+    # 1s sleeps until the 3.5s budget is gone: far fewer than 100 attempts.
+    assert ei.value.attempts <= 6
+    assert clock["t"] <= 3.6
+
+
+def test_retry_counter_increments_by_site():
+    before = events.retry_attempts.value(site="test.site")
+    calls = []
+
+    def fn():
+        calls.append(1)
+        if len(calls) < 3:
+            raise ValueError("x")
+        return 1
+
+    retry_call(fn, site="test.site", policy=RetryPolicy(max_attempts=5, base_s=0.0),
+               sleeper=lambda s: None)
+    assert events.retry_attempts.value(site="test.site") == before + 2

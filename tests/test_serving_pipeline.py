@@ -219,6 +219,89 @@ def test_als_source_survives_topk_wider_than_catalog(artifacts):
         batcher.stop()
 
 
+# --- fault-injected chaos over HTTP ------------------------------------------
+# The degradation matrix driven by the REAL fault sites through the REAL
+# server — no hand-stubbed errors anywhere in the request path.
+
+
+def _get_json(host, port, path):
+    import json as _json
+    import urllib.request
+
+    with urllib.request.urlopen(f"http://{host}:{port}{path}", timeout=15) as r:
+        return _json.loads(r.read().decode())
+
+
+def test_fault_injected_ranker_error_degrades_over_http(artifacts):
+    from albedo_tpu.serving import serve
+    from albedo_tpu.utils import faults
+
+    with _service(artifacts, ranker=StubRanker()) as svc:
+        handle = serve(svc, port=0)
+        try:
+            host, port = handle.server_address[:2]
+            _, matrix, _, _ = artifacts
+            uid = int(matrix.user_ids[4])
+            faults.arm("serving.rank", kind="error", at=1)
+            body = _get_json(host, port, f"/recommend/{uid}")
+            assert "ranker_error" in body["degraded"]
+            assert body["stage"] == "stage1_als"
+            assert body["items"]
+            # The next request is healthy again (times=1): full two-stage.
+            body2 = _get_json(host, port, f"/recommend/{uid}?k=5")
+            assert body2["stage"] == "two_stage"
+            # Both the degradation counter and the fault firing are on /metrics.
+            import urllib.request
+
+            with urllib.request.urlopen(f"http://{host}:{port}/metrics", timeout=15) as r:
+                text = r.read().decode()
+            assert 'albedo_degraded_total{reason="ranker_error"} 1' in text
+            assert 'albedo_faults_fired_total{site="serving.rank"}' in text
+        finally:
+            handle.shutdown()
+
+
+def test_fault_injected_source_error_degrades_over_http(artifacts):
+    from albedo_tpu.serving import serve
+    from albedo_tpu.utils import faults
+
+    with _service(artifacts, ranker=StubRanker()) as svc:
+        handle = serve(svc, port=0)
+        try:
+            host, port = handle.server_address[:2]
+            _, matrix, _, _ = artifacts
+            faults.arm("serving.source.popularity", kind="ioerror", at=1)
+            body = _get_json(host, port, f"/recommend/{int(matrix.user_ids[5])}")
+            assert "candidate_error_popularity" in body["degraded"]
+            # ALS candidates survived, so the request still re-ranked.
+            assert body["stage"] == "two_stage"
+            assert body["items"]
+        finally:
+            handle.shutdown()
+
+
+def test_fault_injected_source_delay_times_out_over_http(artifacts):
+    from albedo_tpu.serving import serve
+    from albedo_tpu.utils import faults
+
+    with _service(
+        artifacts, ranker=None,
+        deadlines=StageDeadlines(candidates_s=0.15, ranker_s=0.5),
+    ) as svc:
+        handle = serve(svc, port=0)
+        try:
+            host, port = handle.server_address[:2]
+            _, matrix, _, _ = artifacts
+            faults.arm("serving.source.popularity", kind="delay", param=1.5, at=1)
+            t0 = time.monotonic()
+            body = _get_json(host, port, f"/recommend/{int(matrix.user_ids[6])}")
+            assert time.monotonic() - t0 < 1.4  # deadline, not the fault's 1.5s
+            assert "candidate_timeout_popularity" in body["degraded"]
+            assert body["items"] and all(i["source"] == "als" for i in body["items"])
+        finally:
+            handle.shutdown()
+
+
 def test_stage_timings_reach_metrics(artifacts):
     with _service(artifacts, ranker=StubRanker()) as svc:
         _, matrix, _, _ = artifacts

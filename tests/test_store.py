@@ -175,6 +175,115 @@ def test_store_drop_data(world):
     assert all(v == 0 for v in store.counts().values())
 
 
+# --- retry/backoff + chaos ---------------------------------------------------
+
+
+def test_crawler_honors_retry_after_header(world):
+    """A 403 carrying Retry-After sleeps the server's number, not 30 min."""
+    state = {"first": True}
+
+    def transport(path, params, token):
+        if path == "/users/alice" and state.pop("first", False):
+            return 403, None, {"Retry-After": "7"}
+        return world.transport(path, params, token)
+
+    sleeps = []
+    store = EntityStore()
+    crawler = GitHubCrawler(store, transport=transport, sleeper=sleeps.append)
+    crawler.collect(["alice"])
+    assert sleeps[0] == 7.0
+    assert crawler.stats.rate_limit_sleeps == 1
+    assert store.counts()["app_userinfo"] == 3
+
+
+def test_rate_limit_delay_header_precedence():
+    from albedo_tpu.store.crawler import RATE_LIMIT_SLEEP_S, rate_limit_delay
+
+    # Retry-After wins over X-RateLimit-Reset; header names case-insensitive.
+    assert rate_limit_delay({"retry-after": "5", "X-RateLimit-Reset": "999999"}) == 5.0
+    # Reset is epoch seconds: wait the remaining window.
+    assert rate_limit_delay({"X-RateLimit-Reset": "1000"}, now=lambda: 900.0) == 100.0
+    # A reset in the past clamps to zero, not a negative sleep.
+    assert rate_limit_delay({"X-RateLimit-Reset": "800"}, now=lambda: 900.0) == 0.0
+    # No headers (every legacy 2-tuple transport): the reference's 30 minutes.
+    assert rate_limit_delay({}) == RATE_LIMIT_SLEEP_S
+    assert rate_limit_delay(None) == RATE_LIMIT_SLEEP_S
+    # Garbage header values fall through, never raise.
+    assert rate_limit_delay({"Retry-After": "soon"}) == RATE_LIMIT_SLEEP_S
+    # Bogus huge values (or ms-resolution resets) clamp to the 30-min ceiling
+    # instead of parking a crawler thread for days.
+    assert rate_limit_delay({"Retry-After": "10000000"}) == RATE_LIMIT_SLEEP_S
+    assert rate_limit_delay(
+        {"X-RateLimit-Reset": "1776000000000"}, now=lambda: 1776000000.0
+    ) == RATE_LIMIT_SLEEP_S
+
+
+def test_crawler_5xx_uses_jittered_backoff(world):
+    """Transient 5xx retries back off exponentially (bounded by the policy
+    caps) instead of the seed's fixed sleep(1.0), and don't count as
+    rate-limit sleeps."""
+    failures = {"n": 2}
+
+    def transport(path, params, token):
+        if path == "/users/alice" and failures["n"] > 0:
+            failures["n"] -= 1
+            return 502, None
+        return world.transport(path, params, token)
+
+    sleeps = []
+    store = EntityStore()
+    crawler = GitHubCrawler(store, transport=transport, sleeper=sleeps.append)
+    crawler.collect(["alice"])
+    assert len(sleeps) == 2
+    assert 0.0 <= sleeps[0] <= 0.5  # full jitter within base_s cap
+    assert 0.0 <= sleeps[1] <= 1.0  # second retry: doubled cap
+    assert crawler.stats.rate_limit_sleeps == 0
+    assert store.counts()["app_userinfo"] == 3
+
+
+def test_crawler_gives_up_after_persistent_5xx(world):
+    from albedo_tpu.store.crawler import RateLimited
+
+    def transport(path, params, token):
+        return 500, None
+
+    crawler = GitHubCrawler(EntityStore(), transport=transport, sleeper=lambda s: None)
+    with pytest.raises(RateLimited):
+        crawler._request("/users/alice")
+    assert crawler.stats.requests == 5  # MAX_RETRIES attempts, then give up
+
+
+def test_rate_limit_sleep_counter_matches_performed_sleeps():
+    """A 403 on the FINAL attempt gives up without sleeping — the counter
+    must not count a sleep that never happened."""
+    from albedo_tpu.store.crawler import MAX_RETRIES, RateLimited
+
+    def transport(path, params, token):
+        return 403, None, {"Retry-After": "1"}
+
+    sleeps = []
+    crawler = GitHubCrawler(EntityStore(), transport=transport, sleeper=sleeps.append)
+    with pytest.raises(RateLimited):
+        crawler._request("/users/alice")
+    assert len(sleeps) == MAX_RETRIES - 1
+    assert crawler.stats.rate_limit_sleeps == len(sleeps)
+
+
+def test_crawler_transport_fault_site_is_retried(world):
+    """An injected IOError at the transport fault site behaves like a flaky
+    network: retried with backoff, then the crawl succeeds."""
+    from albedo_tpu.utils import faults
+
+    faults.arm("crawler.transport", kind="ioerror", at=1)
+    sleeps = []
+    store = EntityStore()
+    crawler = GitHubCrawler(store, transport=world.transport, sleeper=sleeps.append)
+    crawler.collect(["alice"])
+    assert faults.FAULTS.fired("crawler.transport") == 1
+    assert len(sleeps) >= 1
+    assert store.counts()["app_userinfo"] == 3
+
+
 # --- content index -----------------------------------------------------------
 
 
