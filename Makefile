@@ -10,7 +10,7 @@ JOBS = popularity curation content train_als cv_als build_user_profile \
        tfidf_content ranking_mf collect_data drop_data sync_index serve play \
        run_pipeline
 
-.PHONY: $(JOBS) test test-all bench serve-bench chaos dryrun
+.PHONY: $(JOBS) test test-all bench serve-bench chaos chaos-serve dryrun
 
 $(JOBS):
 	$(PY) -m albedo_tpu.cli $@ $(ARGS)
@@ -36,6 +36,12 @@ serve-bench:
 # degradation over HTTP). CPU-safe; includes the slow subprocess drills.
 chaos:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m chaos
+
+# Serving-plane chaos only (fast; no CLI subprocess drills): corrupt-artifact
+# hot-swap quarantine, swap-under-load parity, breaker trip/recovery, and
+# overload shedding through real HTTP.
+chaos-serve:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m chaos -k "serving or reload or breaker"
 
 dryrun:
 	$(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('ok')"

@@ -648,10 +648,17 @@ def serve_job(args) -> None:
     pre-compiling the batch-shape ladder), --two-stage (register the
     popularity + curation candidate sources and train/load the LR ranker
     for online re-ranking), --cache-ttl SECONDS (default 30; 0 disables),
-    --max-batch N (default 64), --window-ms MS (batching window, default 2).
+    --max-batch N (default 64), --window-ms MS (batching window, default 2),
+    --reload-watch (poll the artifact store and hot-swap fresh run_pipeline
+    outputs through the validation gates), --reload-interval SECONDS (watch
+    poll period, default 10). SIGHUP triggers one validated reload
+    immediately (watched or not), and POST /admin/reload does the same over
+    HTTP — see the README live-ops runbook.
     """
+    import signal
+
     from albedo_tpu.recommenders import CurationRecommender, PopularityRecommender
-    from albedo_tpu.serving import RecommendationService, serve
+    from albedo_tpu.serving import HotSwapManager, RecommendationService, serve
 
     extra = argparse.ArgumentParser()
     extra.add_argument("--port", type=int, default=8080)
@@ -663,6 +670,8 @@ def serve_job(args) -> None:
     extra.add_argument("--cache-ttl", type=float, default=30.0)
     extra.add_argument("--max-batch", type=int, default=64)
     extra.add_argument("--window-ms", type=float, default=2.0)
+    extra.add_argument("--reload-watch", action="store_true")
+    extra.add_argument("--reload-interval", type=float, default=10.0)
     ns, _ = extra.parse_known_args(getattr(args, "_rest", []))
 
     ctx = JobContext(args)
@@ -689,13 +698,37 @@ def serve_job(args) -> None:
         cache_ttl=ns.cache_ttl, max_batch=ns.max_batch,
         batch_window_ms=ns.window_ms,
     )
+    # Live-ops plane: the hot-swap manager always exists (SIGHUP and
+    # POST /admin/reload work out of the box); --reload-watch additionally
+    # polls the store so the compose ingest->train->serve loop picks up
+    # fresh artifacts with no restart and no signal.
+    manager = HotSwapManager(
+        service,
+        artifact_glob=f"{ctx.tag}-alsModel-*.pkl",
+        watch_interval_s=ns.reload_interval,
+    )
+    if ns.reload_watch:
+        manager.start_watch()
+    if hasattr(signal, "SIGHUP"):
+        def _sighup(_sig, _frame):
+            # Reload on a worker thread: gates + batcher warm are seconds of
+            # work and a signal handler must not block the main thread.
+            threading.Thread(
+                target=manager.request_reload, name="albedo-sighup-reload",
+                daemon=True,
+            ).start()
+
+        signal.signal(signal.SIGHUP, _sighup)
+
     server = serve(service, host=ns.host, port=ns.port)
     host, port = server.server_address[:2]
     mode = "two-stage" if ns.two_stage else "als"
     print(f"[serve] listening on http://{host}:{port}/ "
-          f"(/recommend/<user_id>, /admin/repos, /admin/users, /metrics) "
+          f"(/recommend/<user_id>, /admin/repos, /admin/users, /metrics, "
+          f"/healthz/ready; POST /admin/reload) "
           f"[{mode}, batching={'off' if ns.no_batch else 'on'}, "
-          f"cache_ttl={ns.cache_ttl:g}s]")
+          f"cache_ttl={ns.cache_ttl:g}s, "
+          f"reload={'watch' if ns.reload_watch else 'on-demand'}]")
     try:
         if ns.duration > 0:
             time.sleep(ns.duration)

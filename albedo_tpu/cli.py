@@ -72,7 +72,10 @@ def main(argv: list[str] | None = None) -> int:
         help="disable the persistent XLA executable cache (on by default; "
         "directory = $ALBEDO_DATA_DIR/jax-cache, overridable via "
         "JAX_COMPILATION_CACHE_DIR; ALBEDO_JAX_CACHE=0 is the env "
-        "equivalent of this flag)",
+        "equivalent of this flag). Cached-executable reuse is "
+        "output-fingerprint verified (utils/aot.py; ALBEDO_AOT_FINGERPRINT=0 "
+        "to skip the check): an executable that cannot reproduce the "
+        "exporting process's probe output is discarded and recompiled",
     )
     parser.add_argument(
         "--platform",

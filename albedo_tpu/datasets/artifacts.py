@@ -19,6 +19,12 @@ counted in the process-global ``albedo_artifact_corruptions_total{artifact=}``
 counter (``utils.events``), which the serving `/metrics` page renders.
 Fault sites ``artifact.load`` / ``artifact.save`` (``utils.faults``) let
 chaos tests flip bytes or fail IO exactly here.
+
+The serving hot-swap manager (``serving.reload``) reuses this module's
+integrity surface as its first validation gates: ``verify_manifest`` guards
+candidate model artifacts before they are loaded, and a candidate failing
+any gate is moved aside with the same ``quarantine`` convention — one
+healing story for offline reruns and live swaps.
 """
 
 from __future__ import annotations

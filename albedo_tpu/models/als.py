@@ -35,7 +35,7 @@ from albedo_tpu.datasets.ragged import (
 from albedo_tpu.datasets.star_matrix import StarMatrix
 from albedo_tpu.ops.als import als_fit_fused, als_init_fit_fused
 from albedo_tpu.ops.topk import topk_scores
-from albedo_tpu.utils.aot import persistent_aot_call
+from albedo_tpu.utils.aot import persistent_aot_call, persistent_aot_executable
 
 
 class ALSModel:
@@ -504,14 +504,28 @@ class ImplicitALS:
                     name="als_fit_fused",
                 )
             else:
-                # One fused dispatch per iteration (same executable: n_iter is
-                # traced), surfacing factors to the host for the callback.
+                # One fused dispatch per iteration (same executable: n_iter
+                # is traced), surfacing factors to the host for the callback.
+                # Acquired through the AOT layer like the single-dispatch
+                # path: the checkpointed chunks this serves are exactly what
+                # kill-resume drills re-run in a fresh process, so their
+                # cross-process executable reuse must be output-fingerprint
+                # verified too (a plain jit call here rode the persistent
+                # XLA cache unguarded — the source of the PR 3 drift).
+                one = jnp.int32(1)
+                step_kwargs = dict(user_landing=u_land, item_landing=i_land)
+                compiled_step, compile_s, compile_source = persistent_aot_executable(
+                    als_fit_fused,
+                    (user_f, item_f, ug, ig, reg, alpha, one),
+                    step_kwargs,
+                    dict(solver=self.solver, cg_steps=self.cg_steps,
+                         gather_dtype=self.gather_dtype),
+                    key_parts=self._aot_key_parts("als_fit_step", matrix, ug, ig),
+                    name="als_fit_step",
+                )
                 for it in range(self.max_iter):
-                    user_f, item_f = als_fit_fused(
-                        user_f, item_f, ug, ig, reg, alpha, jnp.int32(1),
-                        solver=self.solver, cg_steps=self.cg_steps,
-                        user_landing=u_land, item_landing=i_land,
-                        gather_dtype=self.gather_dtype,
+                    user_f, item_f = compiled_step(
+                        user_f, item_f, ug, ig, reg, alpha, one, **step_kwargs
                     )
                     callback(it, np.asarray(user_f), np.asarray(item_f))
         # Synchronize via a tiny device->host read of values that depend on

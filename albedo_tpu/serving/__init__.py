@@ -11,12 +11,22 @@ The seed's ``serving.py`` module, promoted into a subsystem:
 - ``cache``    — :class:`TTLCache`, hot-user result cache
 - ``metrics``  — :class:`MetricsRegistry`, Prometheus ``/metrics`` plane
 - ``http``     — routes, hardening, load shedding, :func:`serve`
+- ``breaker``  — :class:`CircuitBreaker`, per-source closed/open/half-open
+  failure isolation with jittered reopen
+- ``reload``   — :class:`HotSwapManager`, validated zero-downtime model
+  hot-swap (watch -> gate -> promote -> rollback)
 
 The seed import surface (``from albedo_tpu.serving import
 RecommendationService, serve``) is unchanged.
 """
 
-from albedo_tpu.serving.batcher import MicroBatcher, QueueOverflow
+from albedo_tpu.serving.batcher import (
+    BatcherClosed,
+    DeadlineExceeded,
+    MicroBatcher,
+    QueueOverflow,
+)
+from albedo_tpu.serving.breaker import BreakerConfig, CircuitBreaker
 from albedo_tpu.serving.cache import TTLCache
 from albedo_tpu.serving.http import ServerHandle, serve
 from albedo_tpu.serving.metrics import MetricsRegistry
@@ -25,14 +35,22 @@ from albedo_tpu.serving.pipeline import (
     StageDeadlines,
     TwoStagePipeline,
 )
-from albedo_tpu.serving.service import RecommendationService
+from albedo_tpu.serving.reload import HotSwapManager, ReloadRejected
+from albedo_tpu.serving.service import ModelGeneration, RecommendationService
 
 __all__ = [
     "BatchedALSSource",
+    "BatcherClosed",
+    "BreakerConfig",
+    "CircuitBreaker",
+    "DeadlineExceeded",
+    "HotSwapManager",
     "MetricsRegistry",
     "MicroBatcher",
+    "ModelGeneration",
     "QueueOverflow",
     "RecommendationService",
+    "ReloadRejected",
     "ServerHandle",
     "StageDeadlines",
     "TTLCache",

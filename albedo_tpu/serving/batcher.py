@@ -30,7 +30,14 @@ Mechanics:
   tracing, no signature hashing, no cache lookup. ``warm()`` pre-compiles
   the whole ladder at startup so no request ever pays a trace+compile.
 - Bounded queue: ``submit`` on a full queue raises :class:`QueueOverflow`
-  (the HTTP layer turns it into a 429) instead of letting latency collapse.
+  (the HTTP layer turns it into a 429) instead of letting latency collapse;
+  the exception carries a ``Retry-After`` estimate priced from queue depth
+  at the observed (EWMA) batch latency.
+- Deadline-aware admission control: a request submitted with a ``deadline``
+  that lapses while it queues is shed (:class:`DeadlineExceeded`, also a
+  429) before the worker spends a device batch on it — under overload the
+  survivors keep bounded latency instead of every request blowing its
+  deadline together.
 
 Parity: the batched path must be byte-identical to the single-request path
 (``ALSModel.recommend``) — both gather user rows with ``jnp.take`` from the
@@ -47,7 +54,7 @@ import logging
 import queue
 import threading
 import time
-from concurrent.futures import Future
+from concurrent.futures import Future, InvalidStateError
 
 import jax
 import jax.numpy as jnp
@@ -62,7 +69,29 @@ log = logging.getLogger(__name__)
 
 
 class QueueOverflow(RuntimeError):
-    """The batcher's bounded request queue is full — shed load upstream."""
+    """The batcher's bounded request queue is full — shed load upstream.
+
+    ``retry_after_s`` (when set) is the batcher's estimate of when capacity
+    returns — queue depth priced at the observed batch latency — which the
+    HTTP layer surfaces as the 429's ``Retry-After`` header.
+    """
+
+    def __init__(self, message: str, retry_after_s: float | None = None):
+        super().__init__(message)
+        self.retry_after_s = retry_after_s
+
+
+class DeadlineExceeded(QueueOverflow):
+    """Admission control: the request's deadline expired while it waited in
+    the queue. Computing its batch anyway would burn device time producing
+    an answer the client has already abandoned — shed it instead (HTTP 429,
+    same contract as queue overflow: come back later, with ``Retry-After``).
+    """
+
+
+class BatcherClosed(RuntimeError):
+    """submit() raced a shutdown/retirement — the caller should re-resolve
+    the current engine generation and retry, not fail the request."""
 
 
 @functools.partial(jax.jit, static_argnames=("k", "item_block"))
@@ -96,9 +125,27 @@ class _Request:
     # None = no exclusion; True = device-table exclusion; ndarray = host row.
     exclude: "np.ndarray | bool | None"
     future: Future
+    # Admission control: monotonic deadline; the worker sheds the request
+    # instead of computing it if the deadline passes while it queues.
+    deadline: float | None = None
 
 
 _SENTINEL = object()
+
+
+def _resolve(fut: Future, value=None, exc: BaseException | None = None) -> bool:
+    """Resolve a request future, tolerating a client-side cancel racing the
+    done() check (a deadline_ms caller cancels from the HTTP thread; losing
+    that race must not blow up the whole batch with InvalidStateError).
+    Returns True if THIS call resolved the future."""
+    try:
+        if exc is not None:
+            fut.set_exception(exc)
+        else:
+            fut.set_result(value)
+        return True
+    except InvalidStateError:
+        return False
 
 
 class MicroBatcher:
@@ -151,6 +198,10 @@ class MicroBatcher:
         self._closed = False
         self.batches_run = 0
         self.requests_served = 0
+        self.warmed = False
+        # EWMA of batch execution latency (seconds) — prices the Retry-After
+        # estimate; seeded pessimistically until the first real batch lands.
+        self._ewma_batch_s = 0.05
         self._worker = threading.Thread(
             target=self._run, name="albedo-micro-batcher", daemon=True
         )
@@ -162,16 +213,31 @@ class MicroBatcher:
     def device_exclusion(self) -> bool:
         return self._excl_dev is not None
 
+    def retry_after_s(self) -> float:
+        """When should a shed client come back? Queue depth priced in batches
+        at the observed batch latency, clamped to [1, 30] seconds — an
+        estimate for the 429 ``Retry-After`` header, not a promise."""
+        depth = self._queue.qsize()
+        batches_ahead = depth / self.max_batch + 1.0
+        return float(min(30.0, max(1.0, batches_ahead * self._ewma_batch_s)))
+
     def submit(
-        self, dense_user: int, k: int, exclude: "np.ndarray | bool | None" = None
+        self,
+        dense_user: int,
+        k: int,
+        exclude: "np.ndarray | bool | None" = None,
+        deadline: float | None = None,
     ) -> Future:
         """Enqueue one request; resolve to ``(scores (k,), item_idx (k,))``.
 
         ``exclude``: ``None`` scores all items; ``True`` uses the device
         exclusion table (requires one); an int32 row of seen item indices
-        excludes host-side."""
+        excludes host-side. ``deadline`` (``time.monotonic()`` timestamp)
+        opts into admission control: a request still queued past its
+        deadline is shed (:class:`DeadlineExceeded` on the future) instead
+        of computed."""
         if self._closed:
-            raise RuntimeError("batcher is shut down")
+            raise BatcherClosed("batcher is shut down")
         if exclude is True and self._excl_dev is None:
             raise ValueError("exclude=True needs an exclude_table")
         if isinstance(exclude, np.ndarray) and exclude.size > self.excl_width:
@@ -187,17 +253,18 @@ class MicroBatcher:
                 f"user index out of range [0, {self._n_users}): {dense_user}"
             )
         fut: Future = Future()
-        req = _Request(int(dense_user), int(k), exclude, fut)
+        req = _Request(int(dense_user), int(k), exclude, fut, deadline=deadline)
         try:
             with self._submit_lock:
                 if self._closed:
-                    raise RuntimeError("batcher is shut down")
+                    raise BatcherClosed("batcher is shut down")
                 self._queue.put_nowait(req)
         except queue.Full:
             if self.metrics is not None:
                 self.metrics.shed.inc()
             raise QueueOverflow(
-                f"serving queue full ({self._queue.maxsize} waiting)"
+                f"serving queue full ({self._queue.maxsize} waiting)",
+                retry_after_s=self.retry_after_s(),
             ) from None
         return fut
 
@@ -229,6 +296,7 @@ class MicroBatcher:
                             "(%s, %.2fs)", bucket, k, mode, source, compile_s
                         )
             bucket *= 2
+        self.warmed = True
         return sources
 
     def stop(self, drain: bool = True, timeout: float = 10.0) -> None:
@@ -255,7 +323,7 @@ class MicroBatcher:
             except queue.Empty:
                 break
             if isinstance(req, _Request):
-                req.future.set_exception(RuntimeError("batcher shut down"))
+                _resolve(req.future, exc=BatcherClosed("batcher shut down"))
 
     @property
     def mean_batch_size(self) -> float:
@@ -296,7 +364,10 @@ class MicroBatcher:
                 self._drain_into(batch)
             if self._abort.is_set():
                 for req in batch:
-                    req.future.set_exception(RuntimeError("batcher shut down"))
+                    _resolve(req.future, exc=BatcherClosed("batcher shut down"))
+                continue
+            batch = self._shed_expired(batch)
+            if not batch:
                 continue
             groups: dict[tuple[int, str], list[_Request]] = {}
             for req in batch:
@@ -311,8 +382,30 @@ class MicroBatcher:
                     self._execute(k_exec, mode, reqs)
                 except Exception as e:  # noqa: BLE001 — fail the batch, not the worker
                     for req in reqs:
-                        if not req.future.done():
-                            req.future.set_exception(e)
+                        _resolve(req.future, exc=e)
+
+    def _shed_expired(self, batch: list) -> list:
+        """Admission control: fail requests whose deadline already passed
+        (the client gave up or will) rather than spending a device batch on
+        them — under overload this is what keeps the survivors' latency
+        bounded instead of uniformly blowing every deadline."""
+        now = time.monotonic()
+        live: list[_Request] = []
+        for req in batch:
+            if req.deadline is not None and now >= req.deadline:
+                # A lost _resolve race means the submitter already gave up
+                # (it shed client-side and cancelled) — don't recount.
+                if _resolve(req.future, exc=DeadlineExceeded(
+                    "request deadline expired while queued",
+                    retry_after_s=self.retry_after_s(),
+                )):
+                    if self.metrics is not None:
+                        self.metrics.shed.inc()
+                        if hasattr(self.metrics, "deadline_shed"):
+                            self.metrics.deadline_shed.inc()
+            else:
+                live.append(req)
+        return live
 
     def _drain_into(self, batch: list) -> None:
         while len(batch) < self.max_batch:
@@ -380,12 +473,13 @@ class MicroBatcher:
             vals, idx = compiled(self._uf, self._vf, user_idx, excl)
         vals, idx = np.asarray(vals), np.asarray(idx)
         for i, req in enumerate(reqs):
-            if not req.future.done():
-                # k was quantized up for the executable; each request gets
-                # exactly its own top-k back (top-j == first j of top-K).
-                req.future.set_result((vals[i, : req.k], idx[i, : req.k]))
+            # k was quantized up for the executable; each request gets
+            # exactly its own top-k back (top-j == first j of top-K).
+            _resolve(req.future, (vals[i, : req.k], idx[i, : req.k]))
         self.batches_run += 1
         self.requests_served += len(reqs)
+        batch_s = time.perf_counter() - t0
+        self._ewma_batch_s += 0.2 * (batch_s - self._ewma_batch_s)
         if self.metrics is not None:
             self.metrics.batch_size.observe(len(reqs))
-            self.metrics.batch_latency.observe(time.perf_counter() - t0)
+            self.metrics.batch_latency.observe(batch_s)

@@ -9,6 +9,11 @@ invalidate a user — or everything — explicitly.
 LRU + TTL: entries expire ``ttl`` seconds after WRITE (results don't get
 fresher by being read), capacity evicts least-recently-used. ``clock`` is
 injectable so tests drive expiry deterministically instead of sleeping.
+
+Hot-swap interaction (``serving.reload``): cached bodies carry the model
+generation that computed them, the service's cache key includes the
+generation number, and ``promote()`` flushes the cache outright — a swapped
+process can never answer from the displaced model's results.
 """
 
 from __future__ import annotations
@@ -74,3 +79,11 @@ class TTLCache:
         now = self.clock()
         with self._lock:
             return sum(1 for (e, _u, _v) in self._data.values() if now < e)
+
+    def stats(self) -> dict:
+        """Live/total entry counts for the readiness report."""
+        now = self.clock()
+        with self._lock:
+            total = len(self._data)
+            live = sum(1 for (e, _u, _v) in self._data.values() if now < e)
+        return {"live_entries": live, "total_entries": total, "maxsize": self.maxsize}

@@ -5,11 +5,15 @@ Reference parity: the Django web layer — ``app/views.py`` + ``app/urls.py``
 online engine's operational surface:
 
   GET  /                       index page (route listing)
-  GET  /healthz                liveness probe
+  GET  /healthz                liveness probe (also /healthz/live)
+  GET  /healthz/ready          readiness: 503 until a VALIDATED model
+                               generation is promoted; JSON reports the
+                               generation, batcher warmth, breaker states
   GET  /metrics                Prometheus text exposition (0.0.4)
-  GET  /recommend/<user_id>?k=30&exclude_seen=1   engine top-k
+  GET  /recommend/<user_id>?k=30&exclude_seen=1&deadline_ms=250   engine top-k
   GET  /admin/repos?q=&limit=  repo list/search
   GET  /admin/users?q=&limit=  user list/search
+  POST /admin/reload[?artifact=]                  validated model hot-swap
   POST /cache/invalidate[?user_id=]               explicit cache invalidation
 
 Hardening (every rule tested in ``tests/test_serving_http.py``):
@@ -20,7 +24,14 @@ Hardening (every rule tested in ``tests/test_serving_http.py``):
 - ``q`` is length-capped before it reaches pandas.
 - Unexpected exceptions return a 500 **with a JSON body** — the seed's
   handler only caught ValueError/KeyError and left the socket to die.
-- Queue overflow (``QueueOverflow``) returns 429 + ``Retry-After``.
+- Queue overflow and deadline sheds (``QueueOverflow`` and its
+  ``DeadlineExceeded`` subclass) return 429 + ``Retry-After`` priced from
+  the batcher's observed throughput; ``deadline_ms`` opts a request into
+  deadline-aware admission control.
+- A submit racing a hot-swap retirement (``BatcherClosed``) is retried
+  inside the service against the live generation; one escaping anyway is a
+  503 + ``Retry-After``, not a 500 — the engine is mid-transition, not
+  broken.
 
 ``serve()`` returns a :class:`ServerHandle`: context-manager friendly,
 idempotent ``shutdown()`` that stops accepting, joins the server thread, and
@@ -36,7 +47,7 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
-from albedo_tpu.serving.batcher import QueueOverflow
+from albedo_tpu.serving.batcher import BatcherClosed, QueueOverflow
 from albedo_tpu.serving.service import RecommendationService
 
 log = logging.getLogger(__name__)
@@ -49,11 +60,12 @@ _INDEX_HTML = """<!doctype html>
 <body><h1>Albedo-TPU</h1>
 <p>A github repo recommender, served from trained artifacts.</p>
 <ul>
-<li>GET /recommend/&lt;user_id&gt;?k=30&amp;exclude_seen=1</li>
+<li>GET /recommend/&lt;user_id&gt;?k=30&amp;exclude_seen=1&amp;deadline_ms=250</li>
 <li>GET /admin/repos?q=tensor&amp;limit=20</li>
 <li>GET /admin/users?q=vinta&amp;limit=20</li>
 <li>GET /metrics</li>
-<li>GET /healthz</li>
+<li>GET /healthz (liveness) · /healthz/ready (readiness)</li>
+<li>POST /admin/reload?artifact=&lt;name&gt;</li>
 <li>POST /cache/invalidate?user_id=123</li>
 </ul></body></html>"""
 
@@ -119,10 +131,25 @@ def _make_handler(service: RecommendationService):
                 code = 400
                 self._json({"error": str(e)}, code=400)
             except QueueOverflow as e:
-                # Load shedding: the bounded queue protects latency; tell the
-                # client when to come back instead of letting it hang.
+                # Load shedding (queue overflow or deadline shed): the
+                # bounded queue protects latency; tell the client when to
+                # come back — priced from the batcher's throughput — instead
+                # of letting it hang.
                 code = 429
-                self._json({"error": str(e)}, code=429, extra={"Retry-After": "1"})
+                retry_after = getattr(e, "retry_after_s", None) or 1.0
+                self._json(
+                    {"error": str(e)}, code=429,
+                    extra={"Retry-After": str(max(1, round(retry_after)))},
+                )
+            except BatcherClosed:
+                # The request raced a hot-swap retirement past the service's
+                # own retry: transient by construction — the next generation
+                # is live. 503 + come-right-back, never a 500.
+                code = 503
+                self._json(
+                    {"error": "engine generation transition in progress"},
+                    code=503, extra={"Retry-After": "1"},
+                )
             except BrokenPipeError:
                 code = 499  # client went away mid-response; nothing to send
             except Exception as e:  # noqa: BLE001 — 500-with-JSON, never a hung socket
@@ -142,6 +169,34 @@ def _make_handler(service: RecommendationService):
             parts = [p for p in url.path.split("/") if p]
 
             if method == "POST":
+                if parts[:2] == ["admin", "reload"]:
+                    artifact = _str_param(q, "artifact", "")
+                    # Bare artifact file names only: an absolute path or a
+                    # traversal component from the network would let any
+                    # caller make the server unpickle — and then
+                    # quarantine-rename — an arbitrary file. Input hardening
+                    # comes before the manager check: junk is a 400 whether
+                    # or not reloads are configured.
+                    if artifact and (
+                        "/" in artifact or "\\" in artifact
+                        or artifact.startswith(".")
+                    ):
+                        raise BadRequest(
+                            "artifact must be a bare artifact file name"
+                        )
+                    manager = getattr(service, "reload_manager", None)
+                    if manager is None:
+                        self._json(
+                            {"error": "no hot-swap manager configured"}, code=503
+                        )
+                        return 503
+                    report = manager.request_reload(artifact or None)
+                    # Promoted (or nothing to do) is a 200; a rejected or
+                    # rolled-back candidate is a 409 — the caller's artifact
+                    # did not take, and the report says which gate refused.
+                    code = 200 if report.get("outcome") in ("promoted", "no_candidate") else 409
+                    self._json(report, code=code)
+                    return code
                 if parts[:2] == ["cache", "invalidate"]:
                     raw_uid = _str_param(q, "user_id", "")
                     if raw_uid:
@@ -161,8 +216,20 @@ def _make_handler(service: RecommendationService):
                 self._send(200, _INDEX_HTML.encode(), "text/html")
                 return 200
             if parts[0] == "healthz":
-                self._json({"ok": True})
-                return 200
+                if parts[1:2] == ["ready"]:
+                    # Readiness: route traffic here only once a VALIDATED
+                    # model generation is promoted. Liveness stays separate —
+                    # a not-yet-ready process is healthy, just not servable.
+                    ready, report = service.readiness()
+                    self._json(report, code=200 if ready else 503)
+                    return 200 if ready else 503
+                if parts[1:] in ([], ["live"]):
+                    self._json({"ok": True})  # liveness (/healthz, /healthz/live)
+                    return 200
+                # A misspelled readiness probe (/healthz/readiness, ...) must
+                # fail loudly, not report a cold process as healthy.
+                self._json({"error": "not found"}, code=404)
+                return 404
             if parts[0] == "metrics":
                 # Per-stage timings refresh at scrape time (shared Timer).
                 if service.pipeline is not None:
@@ -179,7 +246,15 @@ def _make_handler(service: RecommendationService):
                     raise BadRequest(f"user id must be an integer, got {parts[1]!r}") from None
                 k = _int_param(q, "k", service.default_k, 1, service.max_k)
                 exclude_seen = _str_param(q, "exclude_seen", "1") != "0"
-                code, body = service.handle_recommend(user_id, k=k, exclude_seen=exclude_seen)
+                # Admission control opt-in: a client deadline (ms) the
+                # batcher sheds against instead of computing doomed work.
+                deadline_ms = _int_param(q, "deadline_ms", 0, 0, 120_000)
+                deadline = (
+                    time.monotonic() + deadline_ms / 1e3 if deadline_ms else None
+                )
+                code, body = service.handle_recommend(
+                    user_id, k=k, exclude_seen=exclude_seen, deadline=deadline
+                )
                 self._json(body, code=code)
                 return code
             if parts[:2] == ["admin", "repos"]:

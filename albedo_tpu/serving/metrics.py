@@ -65,7 +65,42 @@ class MetricsRegistry:
             ("reason",),
         )
         self.shed = self.counter(
-            "albedo_shed_total", "Requests rejected with 429 (queue overflow)."
+            "albedo_shed_total",
+            "Requests rejected with 429 (queue overflow or deadline shed).",
+        )
+        self.deadline_shed = self.counter(
+            "albedo_deadline_shed_total",
+            "Requests shed by admission control: deadline expired while queued.",
+        )
+        # --- live-ops plane: hot swap + circuit breakers --------------------
+        self.model_generation = self.gauge(
+            "albedo_model_generation",
+            "Currently-promoted model generation (0 = none promoted yet).",
+        )
+        self.reloads = self.counter(
+            "albedo_reload_total",
+            "Hot-swap reload attempts by outcome (promoted/rejected/rolled_back).",
+            ("outcome",),
+        )
+        self.reload_rejected = self.counter(
+            "albedo_reload_rejected_total",
+            "Hot-swap candidates rejected, by the validation gate that failed.",
+            ("gate",),
+        )
+        self.generation_requests = self.counter(
+            "albedo_generation_requests_total",
+            "Recommend requests answered, by the model generation that served them.",
+            ("generation",),
+        )
+        self.breaker_state = self.gauge(
+            "albedo_breaker_state",
+            "Per-source circuit breaker state (0=closed, 1=half_open, 2=open).",
+            ("source",),
+        )
+        self.breaker_transitions = self.counter(
+            "albedo_breaker_transitions_total",
+            "Circuit breaker state transitions, by source and new state.",
+            ("source", "to"),
         )
         # No `_total` suffix: these render as TYPE gauge (set to absolute
         # Timer.snapshot values at scrape time) and Prometheus reserves

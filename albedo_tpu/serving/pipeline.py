@@ -8,12 +8,19 @@ latency budget, so every stage gets a deadline and a degradation path:
 
 - a candidate source missing its deadline (or raising) is dropped from the
   fusion — the request still answers from the sources that made it;
+- a source that keeps failing trips its **circuit breaker**
+  (``serving.breaker``): subsequent requests skip it outright
+  (``breaker_open_<name>``) instead of re-paying the deadline, until a
+  jittered reopen timer admits a half-open trial call;
 - the ranker missing its deadline (or raising, or dropping every cold pair)
   degrades to **raw ALS scores**, then to the next stage-1 source — never a
   500, never a hang;
 - the ALS source itself runs through the micro-batcher
   (:class:`BatchedALSSource`), so stage-1 fan-outs from concurrent requests
-  coalesce into shared device batches.
+  coalesce into shared device batches. The live ALS source is supplied
+  per-request via ``extra_sources`` — the service passes the source from
+  its current :class:`~albedo_tpu.serving.service.ModelGeneration`
+  snapshot, so a hot-swap can never tear a request across two models.
 
 Every degraded answer is tagged in the response (``"degraded": [reasons]``)
 and counted in ``albedo_degraded_total{reason=...}``; per-stage wall-clock
@@ -23,6 +30,7 @@ accumulates in a ``utils.profiling.Timer`` that the metrics plane exports.
 from __future__ import annotations
 
 import dataclasses
+import threading
 import time
 from concurrent.futures import Future, ThreadPoolExecutor, TimeoutError as FutureTimeout
 
@@ -32,7 +40,8 @@ import pandas as pd
 from albedo_tpu.datasets.ragged import csr_row
 from albedo_tpu.datasets.star_matrix import StarMatrix
 from albedo_tpu.recommenders.base import Recommender, fuse_candidates
-from albedo_tpu.serving.batcher import MicroBatcher
+from albedo_tpu.serving.batcher import BatcherClosed, MicroBatcher
+from albedo_tpu.serving.breaker import STATE_VALUES, BreakerConfig, CircuitBreaker
 from albedo_tpu.utils import faults
 from albedo_tpu.utils.profiling import Timer
 
@@ -126,12 +135,24 @@ class TwoStagePipeline:
         metrics=None,
         max_workers: int = 8,
         timer: Timer | None = None,
+        breaker_config: BreakerConfig | None = None,
+        breakers_enabled: bool = True,
     ):
         self.recommenders = dict(recommenders)
         self.ranker = ranker
         self.deadlines = deadlines or StageDeadlines()
         self.metrics = metrics
         self.timer = timer if timer is not None else Timer()
+        # Per-source circuit breakers, created lazily on first use (sources
+        # can arrive per-request via extra_sources). One breaker per source
+        # NAME: a hot-swapped ALS source inherits the breaker state of the
+        # source it replaced — the dependency is "the ALS stage", not one
+        # model object.
+        self.breaker_config = breaker_config if breakers_enabled else None
+        if breakers_enabled and breaker_config is None:
+            self.breaker_config = BreakerConfig()
+        self.breakers: dict[str, CircuitBreaker] = {}
+        self._breaker_lock = threading.Lock()
         self._pool = ThreadPoolExecutor(
             max_workers=max_workers, thread_name_prefix="albedo-pipeline"
         )
@@ -157,68 +178,168 @@ class TwoStagePipeline:
         if self.metrics is not None:
             self.metrics.degraded.inc(reason=reason)
 
-    def _source_order(self) -> list[str]:
-        names = list(self.recommenders)
+    def _on_breaker_transition(self, name: str, state: str) -> None:
+        if self.metrics is not None and hasattr(self.metrics, "breaker_state"):
+            self.metrics.breaker_state.set(STATE_VALUES[state], source=name)
+            self.metrics.breaker_transitions.inc(source=name, to=state)
+
+    def _breaker(self, name: str) -> CircuitBreaker | None:
+        if self.breaker_config is None:
+            return None
+        br = self.breakers.get(name)
+        if br is None:
+            with self._breaker_lock:
+                br = self.breakers.get(name)
+                if br is None:
+                    br = CircuitBreaker(
+                        name, self.breaker_config,
+                        on_transition=self._on_breaker_transition,
+                    )
+                    if self.metrics is not None and hasattr(self.metrics, "breaker_state"):
+                        self.metrics.breaker_state.set(STATE_VALUES[br.state], source=name)
+                    self.breakers[name] = br
+        return br
+
+    def breaker_states(self) -> dict[str, dict]:
+        """Every source breaker's snapshot — the readiness probe's view."""
+        with self._breaker_lock:
+            breakers = dict(self.breakers)
+        return {name: br.snapshot() for name, br in sorted(breakers.items())}
+
+    def _source_order(self, names) -> list[str]:
         return sorted(
             names,
             key=lambda n: SOURCE_ORDER.index(n) if n in SOURCE_ORDER else len(SOURCE_ORDER),
         )
 
+    def _sources(self, extra_sources: dict | None) -> dict[str, Recommender]:
+        """The fan-out set for one request: the registered sources plus the
+        caller's per-request extras (the generation-snapshot ALS source).
+        Registered names win — an explicitly configured source is not
+        silently replaced."""
+        if not extra_sources:
+            return self.recommenders
+        return {**extra_sources, **self.recommenders}
+
     def candidates(
-        self, user_id: int, degraded: list[str], exclude_seen: bool = True
+        self,
+        user_id: int,
+        degraded: list[str],
+        exclude_seen: bool = True,
+        extra_sources: dict | None = None,
+        deadline: float | None = None,
     ) -> dict[str, pd.DataFrame]:
         """Stage 1: every registered source in parallel, one shared deadline.
         ``exclude_seen`` reaches the sources that honor it (the ALS source);
         popularity/curation/content don't filter by history, as in the
-        reference fusion."""
+        reference fusion. Sources whose breaker is open are skipped outright
+        (``breaker_open_<name>``) — no thread, no deadline wait. A client
+        ``deadline`` (monotonic) caps the stage budget; a source cut short
+        by the CLIENT's deadline (not its own stage budget) degrades but
+        records no breaker outcome — the dependency wasn't given its full
+        chance, so its failure count must not move."""
         users = np.array([int(user_id)], dtype=np.int64)
 
         def call_source(name: str, rec: Recommender) -> pd.DataFrame:
+            # Both chaos hooks live inside the breaker-guarded call:
+            # serving.source.<name> models the source itself failing,
+            # serving.breaker.<name> lets tests trip/recover the breaker
+            # without touching the source (e.g. `:error@1*5` to trip it).
+            faults.hit(f"serving.breaker.{name}")
             faults.hit(f"serving.source.{name}")
             if isinstance(rec, BatchedALSSource):
                 return rec.recommend_for_users(users, exclude_seen)
             return rec.recommend_for_users(users)
 
-        futs: dict[str, Future] = {
-            name: self._pool.submit(call_source, name, rec)
-            for name, rec in self.recommenders.items()
-        }
-        deadline = time.monotonic() + self.deadlines.candidates_s
+        futs: dict[str, Future] = {}
+        for name, rec in self._sources(extra_sources).items():
+            br = self._breaker(name)
+            if br is not None and not br.allow():
+                self._degrade(degraded, f"breaker_open_{name}")
+                continue
+            futs[name] = self._pool.submit(call_source, name, rec)
+        stage_deadline = time.monotonic() + self.deadlines.candidates_s
+        eff_deadline = (
+            stage_deadline if deadline is None else min(stage_deadline, deadline)
+        )
         frames: dict[str, pd.DataFrame] = {}
         for name, fut in futs.items():
+            br = self._breaker(name)
             try:
-                frames[name] = fut.result(timeout=max(0.0, deadline - time.monotonic()))
+                frames[name] = fut.result(
+                    timeout=max(0.0, eff_deadline - time.monotonic())
+                )
+                if br is not None:
+                    br.record_success()
             except FutureTimeout:
                 fut.cancel()
                 self._degrade(degraded, f"candidate_timeout_{name}")
+                if br is not None:
+                    if time.monotonic() >= stage_deadline:
+                        br.record_failure()
+                    else:
+                        br.abandon_trial()
+            except BatcherClosed:
+                # The request's generation snapshot lost a race with a
+                # hot-swap retirement. Not a source failure (the breaker
+                # must not trip on a healthy swap) — propagate so the
+                # service retries the whole request against the live
+                # generation. Sources whose results we now abandon get no
+                # outcome recorded; release any half-open trial slots they
+                # hold or their breakers would deny every later caller.
+                for other in futs:
+                    ob = self._breaker(other)
+                    if ob is not None:
+                        ob.abandon_trial()
+                raise
             except Exception:  # noqa: BLE001 — a broken source degrades, never 500s
                 self._degrade(degraded, f"candidate_error_{name}")
+                if br is not None:
+                    br.record_failure()
         return frames
 
     def _rank(self, candidates: pd.DataFrame) -> pd.DataFrame:
         _RANK_FAULT.hit()
         return self.ranker.score(candidates)
 
-    def recommend(self, user_id: int, k: int, exclude_seen: bool = True) -> dict:
+    def recommend(
+        self,
+        user_id: int,
+        k: int,
+        exclude_seen: bool = True,
+        extra_sources: dict | None = None,
+        deadline: float | None = None,
+    ) -> dict:
         """One online request: returns ``{stage, degraded, items}`` where each
         item is ``{repo_id, score, source}`` (score = LR probability on the
-        full two-stage path, raw stage-1 score on degraded paths)."""
+        full two-stage path, raw stage-1 score on degraded paths).
+        ``extra_sources`` joins the fan-out for THIS request only — the
+        service threads its generation-snapshot ALS source through here.
+        ``deadline`` (client, monotonic) caps every stage budget so the
+        response lands inside it, degrading per the matrix instead of
+        arriving late."""
         degraded: list[str] = []
         timer_section = self.timer.section
         with timer_section("stage1_candidates"):
-            frames = self.candidates(user_id, degraded, exclude_seen=exclude_seen)
+            frames = self.candidates(
+                user_id, degraded, exclude_seen=exclude_seen,
+                extra_sources=extra_sources, deadline=deadline,
+            )
 
-        order = [n for n in self._source_order() if n in frames and len(frames[n])]
+        order = [n for n in self._source_order(frames) if len(frames[n])]
         if not order:
             return {"stage": "empty", "degraded": degraded, "items": []}
         fused = fuse_candidates([frames[n] for n in order])
 
         ranked = None
         if self.ranker is not None:
+            rank_timeout = self.deadlines.ranker_s
+            if deadline is not None:
+                rank_timeout = max(0.0, min(rank_timeout, deadline - time.monotonic()))
             fut = self._rank_pool.submit(self._rank, fused)
             try:
                 with timer_section("stage2_rank"):
-                    ranked = fut.result(timeout=self.deadlines.ranker_s)
+                    ranked = fut.result(timeout=rank_timeout)
             except FutureTimeout:
                 fut.cancel()
                 ranked = None

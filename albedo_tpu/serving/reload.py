@@ -1,0 +1,534 @@
+"""Validated zero-downtime model hot-swap: watch, gate, promote, roll back.
+
+PR 3 made the offline chain preemption-safe; this module closes the loop at
+serving time. The online engine used to load its ALS artifacts once at
+process start and trust them until restart — a fresh ``run_pipeline`` output
+meant a redeploy, and a corrupt factor pickle meant a redeploy THROUGH a
+crash. The ALX posture (arxiv 2112.02194) treats long-lived model state as
+something to be validated and replaced under traffic; the MLlib
+Estimator/Transformer boundary (arxiv 1505.06807) already gates what a
+"model" is — :class:`HotSwapManager` extends that boundary into live ops.
+
+One reload attempt (``request_reload`` — also what the artifact watcher,
+``POST /admin/reload``, and SIGHUP trigger) runs this state machine::
+
+    candidate artifact
+        │  gate 1: manifest   (.sha256 sidecar verifies — corruption stops here)
+        │  gate 2: load       (unpickle + from_arrays; `reload.load` fault site)
+        │  gate 3: invariants (finite factors, rank/shape match the matrix;
+        │                      `reload.validate` fault site)
+        │  gate 4: probe      (fixed-probe top-k smoke test, compared against
+        │                      the incumbent: finite scores, valid indices;
+        │                      overlap/score-delta recorded)
+        ▼
+    build generation  (new micro-batcher, warm-compiled OFF the request path —
+        │              same factor shapes reuse the incumbent's executables)
+        ▼
+    promote           (atomic snapshot swap; cache flushed; generation gauge)
+        ▼
+    post-swap checks  (probe parity THROUGH the promoted serving path must be
+        │              bit-identical to the candidate's direct scoring; the
+        │              watcher also compares post-swap 5xx rate to baseline)
+        ▼
+    finalize          (retire the displaced batcher)  — or —
+    ROLLBACK          (re-promote the incumbent, quarantine the artifact)
+
+A candidate failing any gate is **quarantined** (``<name>.corrupt-<n>``, the
+artifact store's own healing convention) and counted in
+``albedo_reload_rejected_total{gate=}`` + ``albedo_reload_total{outcome=}``;
+the incumbent keeps serving untouched. Every attempt's full gate report is
+kept (``last_report``) and returned to the ``/admin/reload`` caller.
+
+Deliberately NOT handled here: a changed star matrix (new users/items). The
+invariant gate rejects factor shapes that don't match the serving matrix —
+a dataset refresh is a restart, a retrain on the same dataset is a swap.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+
+from albedo_tpu.datasets import artifacts as artifact_store
+from albedo_tpu.models.als import ALSModel
+from albedo_tpu.serving.service import ModelGeneration, RecommendationService
+from albedo_tpu.utils import events, faults
+
+log = logging.getLogger(__name__)
+
+# Chaos hooks: `reload.load` fires before the candidate is read (a `corrupt`
+# kind flips a byte of the candidate file — the manifest gate must catch it);
+# `reload.validate` fires at the head of the validation gates.
+_LOAD_FAULT = faults.site("reload.load")
+_VALIDATE_FAULT = faults.site("reload.validate")
+
+# Sidecar/derived files never themselves reload candidates.
+_SKIP_SUFFIXES = (artifact_store.MANIFEST_SUFFIX, ".tmp")
+_SKIP_MARKERS = (".corrupt-", ".tmp")
+
+
+class ReloadRejected(Exception):
+    """A validation gate failed; ``gate`` names it, ``detail`` says why."""
+
+    def __init__(self, gate: str, detail: str):
+        super().__init__(f"{gate}: {detail}")
+        self.gate = gate
+        self.detail = detail
+
+
+class HotSwapManager:
+    """Watches the artifact store and drives validated model swaps.
+
+    ``service`` must be a :class:`RecommendationService`; the manager
+    installs itself as ``service.reload_manager`` so the HTTP layer can
+    route ``POST /admin/reload`` here and ``service.close()`` stops the
+    watcher.
+
+    ``artifact_glob`` names the watched ``run_pipeline`` product (the
+    ALS-factor pickle). ``probe_users`` fixed dense user indices (spread
+    over the user axis) are scored at every gate/parity check with
+    ``probe_k`` items.
+    """
+
+    def __init__(
+        self,
+        service: RecommendationService,
+        artifact_glob: str = "*alsModel*.pkl",
+        watch_interval_s: float = 10.0,
+        probe_users: int = 8,
+        probe_k: int | None = None,
+        error_rate_threshold: float = 0.5,
+        error_rate_min_requests: int = 10,
+    ):
+        self.service = service
+        self.metrics = service.metrics
+        self.artifact_glob = artifact_glob
+        self.watch_interval_s = float(watch_interval_s)
+        self.probe_k = int(probe_k) if probe_k else service.default_k
+        self.error_rate_threshold = float(error_rate_threshold)
+        self.error_rate_min_requests = int(error_rate_min_requests)
+        matrix = service.matrix
+        n_users = int(matrix.n_users) if matrix is not None else 0
+        self._probe_dense = (
+            np.unique(np.linspace(0, n_users - 1, min(probe_users, n_users)).astype(np.int64))
+            if n_users
+            else np.zeros(0, dtype=np.int64)
+        )
+        self._reload_lock = threading.Lock()  # one reload at a time
+        self._watch_stop = threading.Event()
+        self._watch_thread: threading.Thread | None = None
+        self._seen: dict[str, tuple[float, int]] = {}
+        self._error_baseline: tuple[float, float] | None = None  # (5xx, total)
+        self._displaced_for_rollback: ModelGeneration | None = None
+        self.last_report: dict | None = None
+        service.reload_manager = self
+
+    # --------------------------------------------------------------- probes
+
+    def _probe_direct(self, model: ALSModel) -> tuple[np.ndarray, np.ndarray]:
+        """Score the fixed probe set through the single-request parity path
+        (no batcher) — the reference outputs every later check compares to."""
+        return model.recommend(
+            self._probe_dense, k=self.probe_k, item_block=self.service.item_block
+        )
+
+    def _probe_via_batcher(self, gen: ModelGeneration) -> tuple[np.ndarray, np.ndarray]:
+        futs = [
+            gen.batcher.submit(int(u), self.probe_k, None) for u in self._probe_dense
+        ]
+        outs = [f.result(timeout=30.0) for f in futs]
+        vals = np.stack([np.asarray(v) for v, _ in outs])
+        idx = np.stack([np.asarray(i) for _, i in outs])
+        return vals, idx
+
+    # ---------------------------------------------------------------- gates
+
+    def _gate_manifest(self, path: Path, report: dict) -> None:
+        verdict = artifact_store.verify_manifest(path)
+        if verdict is False:
+            raise ReloadRejected("manifest", "sha256 checksum mismatch")
+        report["gates"]["manifest"] = "ok" if verdict else "missing (unverified)"
+
+    def _gate_load(self, path: Path, report: dict) -> ALSModel:
+        try:
+            arrays = artifact_store.load_pickle(path)
+            model = ALSModel.from_arrays(arrays)
+            # Force host materialization NOW: a truncated pickle that
+            # unpickles but carries garbage buffers should fail here, inside
+            # the gate, not on the first live request.
+            _ = model.user_factors, model.item_factors
+        except ReloadRejected:
+            raise
+        except Exception as e:  # noqa: BLE001 — any unreadable candidate rejects
+            raise ReloadRejected("load", f"{type(e).__name__}: {e}") from e
+        report["gates"]["load"] = "ok"
+        return model
+
+    def _gate_invariants(self, model: ALSModel, report: dict) -> None:
+        _VALIDATE_FAULT.hit()
+        uf, vf = model.user_factors, model.item_factors
+        if uf.ndim != 2 or vf.ndim != 2:
+            raise ReloadRejected(
+                "invariants", f"factors must be 2-D, got {uf.shape}/{vf.shape}"
+            )
+        if uf.shape[1] != vf.shape[1] or uf.shape[1] != model.rank:
+            raise ReloadRejected(
+                "invariants",
+                f"rank mismatch: uf {uf.shape}, vf {vf.shape}, rank {model.rank}",
+            )
+        if not uf.size or not vf.size:
+            raise ReloadRejected("invariants", "empty factor matrices")
+        matrix = self.service.matrix
+        if matrix is not None and (
+            uf.shape[0] != matrix.n_users or vf.shape[0] != matrix.n_items
+        ):
+            raise ReloadRejected(
+                "invariants",
+                f"factor rows {uf.shape[0]}x{vf.shape[0]} do not match the "
+                f"serving matrix {matrix.n_users}x{matrix.n_items} "
+                "(dataset changed? that is a restart, not a swap)",
+            )
+        if not (np.isfinite(uf).all() and np.isfinite(vf).all()):
+            raise ReloadRejected("invariants", "non-finite values in factors")
+        report["gates"]["invariants"] = "ok"
+
+    def _gate_probe(self, model: ALSModel, report: dict) -> tuple[np.ndarray, np.ndarray]:
+        if not self._probe_dense.size:
+            report["gates"]["probe"] = "skipped (no users)"
+            return np.zeros((0, self.probe_k)), np.zeros((0, self.probe_k), np.int32)
+        try:
+            vals, idx = self._probe_direct(model)
+        except Exception as e:  # noqa: BLE001
+            raise ReloadRejected("probe", f"scoring raised {type(e).__name__}: {e}") from e
+        n_items = int(self.service.matrix.n_items) if self.service.matrix is not None else None
+        live = idx >= 0
+        if not live.any():
+            raise ReloadRejected("probe", "no items scored for any probe user")
+        if not np.isfinite(vals[live]).all():
+            raise ReloadRejected("probe", "non-finite probe scores")
+        if n_items is not None and int(idx.max()) >= n_items:
+            raise ReloadRejected("probe", "probe item index out of range")
+        gate: dict = {"users": int(self._probe_dense.size), "k": self.probe_k}
+        incumbent = self.service.generation
+        if incumbent.model is not None:
+            try:
+                ivals, iidx = self._probe_direct(incumbent.model)
+                overlap = np.mean([
+                    len(set(a[a >= 0].tolist()) & set(b[b >= 0].tolist()))
+                    / max(1, int((a >= 0).sum()))
+                    for a, b in zip(idx, iidx)
+                ])
+                gate["overlap_vs_incumbent"] = round(float(overlap), 4)
+                gate["identical_to_incumbent"] = bool(
+                    np.array_equal(idx, iidx) and np.array_equal(vals, ivals)
+                )
+            except Exception:  # noqa: BLE001 — comparison is advisory
+                gate["overlap_vs_incumbent"] = None
+        report["gates"]["probe"] = gate
+        return vals, idx
+
+    # ------------------------------------------------------------- the swap
+
+    def _reject(self, path: Path, report: dict, gate: str, detail: str) -> dict:
+        report.update(outcome="rejected", gate=gate, detail=detail)
+        self.metrics.reloads.inc(outcome="rejected")
+        self.metrics.reload_rejected.inc(gate=gate)
+        events.artifact_corruptions.inc(artifact=path.name)
+        try:
+            quarantined = artifact_store.quarantine(path, reason=f"reload gate {gate}")
+            report["quarantined_to"] = quarantined.name
+        except OSError as e:
+            report["quarantine_error"] = repr(e)
+        log.warning("reload rejected at gate %s: %s (%s)", gate, detail, path.name)
+        return report
+
+    def request_reload(self, path: str | Path | None = None) -> dict:
+        """Run one full validated reload attempt; returns the gate report.
+
+        ``path=None`` picks the newest watched candidate. Serialized: a
+        second caller blocks until the in-flight attempt finishes. The
+        incumbent generation serves traffic untouched for the whole attempt
+        — every expensive step (load, validation, batcher warm) happens off
+        the request path.
+        """
+        with self._reload_lock:
+            report = self._attempt(path)
+        self.last_report = report
+        return report
+
+    def _attempt(self, path: str | Path | None) -> dict:
+        if path is None:
+            candidates = self.candidate_paths()
+            if not candidates:
+                return {"outcome": "no_candidate", "glob": self.artifact_glob}
+            path = candidates[-1]
+        path = Path(path)
+        if not path.is_absolute():
+            # /admin/reload?artifact= passes a bare artifact NAME; resolve
+            # it inside the store and refuse anything that escapes it (the
+            # HTTP layer also rejects separators — this is defense in depth:
+            # a traversal name must never reach the load/quarantine machinery
+            # and rename some unrelated file to .corrupt-<n>).
+            base = artifact_store.get_settings().artifact_dir.resolve()
+            resolved = (base / path).resolve()
+            if not resolved.is_relative_to(base):
+                self.metrics.reloads.inc(outcome="rejected")
+                self.metrics.reload_rejected.inc(gate="load")
+                return {
+                    "artifact": str(path), "gates": {}, "outcome": "rejected",
+                    "gate": "load", "detail": "artifact name escapes the store",
+                }
+            path = resolved
+        report: dict = {"artifact": path.name, "gates": {}, "started_at": time.time()}
+        if not path.exists():
+            report.update(outcome="rejected", gate="load", detail="no such artifact")
+            self.metrics.reloads.inc(outcome="rejected")
+            self.metrics.reload_rejected.inc(gate="load")
+            return report
+
+        try:
+            # The fault site fires BEFORE anything reads the candidate: a
+            # `corrupt` kind flips a byte of the real file and the manifest
+            # gate below must catch it (the corrupt-artifact-mid-serve drill).
+            _LOAD_FAULT.hit(path=path)
+            self._gate_manifest(path, report)
+            model = self._gate_load(path, report)
+            self._gate_invariants(model, report)
+            probe_vals, probe_idx = self._gate_probe(model, report)
+        except ReloadRejected as e:
+            return self._reject(path, report, e.gate, e.detail)
+        except Exception as e:  # noqa: BLE001 — injected ioerror/error kinds land here
+            return self._reject(path, report, "load", f"{type(e).__name__}: {e}")
+
+        # Gates passed: assemble the candidate generation off the request
+        # path (batcher thread + warm compile happen before any promotion).
+        # Warm mirrors the boot configuration: a warmed service gets its
+        # candidate's executable ladder compiled here, OFF the request path
+        # (same factor shapes -> mostly AOT-cache hits from the incumbent).
+        number = self.service.next_generation_number()
+        gen = self.service.build_generation(
+            model, number=number, origin=str(path), validated=True,
+            warm=self.service._warm,
+        )
+        self._error_baseline = self._error_rates()
+        displaced = self.service.promote(gen)
+        self._displaced_for_rollback = displaced
+        report["promoted_generation"] = number
+
+        # Post-swap parity probe: the SAME fixed probes through the now-live
+        # serving path must reproduce the candidate's direct scoring
+        # bit-for-bit (the batched path is parity-pinned to the direct path;
+        # a mismatch means the swap wired the wrong state together).
+        # Transient overload (full queue, a busy worker missing the probe
+        # timeout) is NOT a parity verdict: the gates already validated the
+        # model directly, so the promotion stands and the artifact is NOT
+        # quarantined — rolling back (and destroying the artifact by rename)
+        # on load spikes would pin a busy fleet to its old model forever.
+        from concurrent.futures import TimeoutError as _FutTimeout
+
+        from albedo_tpu.serving.batcher import BatcherClosed, QueueOverflow
+
+        try:
+            ok, detail = self._post_swap_parity(gen, probe_vals, probe_idx)
+        except (QueueOverflow, BatcherClosed, _FutTimeout) as e:
+            ok = True
+            detail = f"inconclusive (transient: {type(e).__name__})"
+            log.warning("post-swap parity probe inconclusive for %s: %r",
+                        path.name, e)
+        except Exception as e:  # noqa: BLE001
+            ok, detail = False, f"post-swap probe raised {type(e).__name__}: {e}"
+        if not ok:
+            self.rollback(displaced, gen, path, reason=detail)
+            report.update(outcome="rolled_back", detail=detail)
+            return report
+
+        report["gates"]["post_swap_parity"] = detail
+        self.service.retire_batcher(
+            displaced.batcher if displaced.batcher is not gen.batcher else None
+        )
+        self.metrics.reloads.inc(outcome="promoted")
+        report.update(outcome="promoted", generation=number)
+        log.info("promoted model generation %d from %s", number, path.name)
+        return report
+
+    def _post_swap_parity(
+        self, gen: ModelGeneration, probe_vals: np.ndarray, probe_idx: np.ndarray
+    ) -> tuple[bool, str]:
+        if gen.batcher is None or not self._probe_dense.size:
+            return True, "skipped (no batcher)"
+        vals, idx = self._probe_via_batcher(gen)
+        if np.array_equal(idx, probe_idx) and np.array_equal(
+            vals.astype(np.float32), probe_vals.astype(np.float32)
+        ):
+            return True, "ok"
+        return False, "post-swap probe parity mismatch (batched != direct)"
+
+    def rollback(
+        self,
+        incumbent: ModelGeneration,
+        bad: ModelGeneration,
+        path: Path | None,
+        reason: str,
+    ) -> None:
+        """Re-promote the displaced incumbent and quarantine the bad
+        artifact. The incumbent was never stopped, so this is the same
+        atomic snapshot swap a promote is — requests that read the bad
+        generation's snapshot finish on it, then it drains."""
+        log.error("rolling back generation %d -> %d: %s",
+                  bad.number, incumbent.number, reason)
+        # The attempt that is rolling back owns the watchdog state it set:
+        # leave either field behind and a later check_error_rate() during an
+        # unrelated 5xx spike would "roll back" the restored incumbent onto
+        # itself and quarantine-rename its own healthy artifact.
+        self._error_baseline = None
+        self._displaced_for_rollback = None
+        self.service.promote(incumbent)
+        self.service.retire_batcher(
+            bad.batcher if bad.batcher is not incumbent.batcher else None
+        )
+        self.metrics.reloads.inc(outcome="rolled_back")
+        if path is not None and Path(path).exists():
+            events.artifact_corruptions.inc(artifact=Path(path).name)
+            try:
+                artifact_store.quarantine(Path(path), reason=f"rollback: {reason}")
+            except OSError:
+                pass
+
+    # -------------------------------------------------- error-rate watchdog
+
+    def _error_rates(self) -> tuple[float, float]:
+        """(5xx count, total count) across every route/status child."""
+        samples = self.metrics.requests.samples()
+        total = sum(v for _, v in samples)
+        errors = sum(
+            v for labels, v in samples if labels.get("status", "").startswith("5")
+        )
+        return float(errors), float(total)
+
+    def check_error_rate(self) -> dict:
+        """Post-swap watchdog: if the 5xx share of traffic since the swap
+        crossed the threshold (with enough requests to mean something),
+        roll back to the incumbent. The watcher calls this one interval
+        after each promotion; tests call it directly. Serialized with
+        reload attempts: a SIGHUP/admin reload landing between the watcher's
+        promotion and its deferred check could otherwise pair THIS check
+        with the new attempt's half-written baseline/displaced fields and
+        roll back across two swaps, quarantining the wrong artifact."""
+        with self._reload_lock:
+            return self._check_error_rate_locked()
+
+    def _check_error_rate_locked(self) -> dict:
+        if self._error_baseline is None:
+            return {"checked": False}
+        base_err, base_total = self._error_baseline
+        now_err, now_total = self._error_rates()
+        d_total = now_total - base_total
+        d_err = now_err - base_err
+        out = {
+            "checked": True,
+            "requests_since_swap": d_total,
+            "errors_since_swap": d_err,
+        }
+        if d_total < self.error_rate_min_requests:
+            out["verdict"] = "insufficient traffic"
+            return out
+        rate = d_err / d_total
+        out["error_rate"] = round(rate, 4)
+        if rate <= self.error_rate_threshold:
+            out["verdict"] = "healthy"
+            self._error_baseline = None  # watchdog satisfied
+            self._displaced_for_rollback = None
+            return out
+        # Regressed: roll back to the incumbent this promotion displaced. If
+        # its batcher was already retired (parity passed, so finalize ran),
+        # rebuild an identical generation from its still-live model.
+        out["verdict"] = "regressed"
+        gen = self.service.generation
+        origin = Path(gen.origin) if gen.origin != "boot" else None
+        prior = self._displaced_for_rollback
+        if prior is not None:
+            if prior.batcher is not None and prior.batcher._closed:
+                prior = self.service.build_generation(
+                    prior.model, number=prior.number, origin=prior.origin,
+                    validated=prior.validated, warm=self.service._warm,
+                )
+            self.rollback(prior, gen, origin, reason=f"error rate {rate:.2f}")
+            out["rolled_back_to"] = prior.number
+            self._displaced_for_rollback = None
+        self._error_baseline = None
+        return out
+
+    # ------------------------------------------------------------- watching
+
+    def candidate_paths(self) -> list[Path]:
+        """Watched artifacts, oldest-to-newest by mtime; sidecars, temp
+        files, and quarantined evidence never count."""
+        art_dir = artifact_store.get_settings().artifact_dir
+        if not art_dir.exists():
+            return []
+        out = []
+        for p in art_dir.glob(self.artifact_glob):
+            name = p.name
+            if name.endswith(_SKIP_SUFFIXES) or any(m in name for m in _SKIP_MARKERS):
+                continue
+            out.append(p)
+        return sorted(out, key=lambda p: (p.stat().st_mtime, p.name))
+
+    def start_watch(self) -> None:
+        """Poll the store for new/changed candidates; the CURRENT contents
+        are baselined (the boot model already reflects them) — only changes
+        after this point trigger reloads."""
+        if self._watch_thread is not None:
+            return
+        for p in self.candidate_paths():
+            st = p.stat()
+            self._seen[str(p)] = (st.st_mtime, st.st_size)
+        self._watch_stop.clear()
+        self._watch_thread = threading.Thread(
+            target=self._watch_loop, name="albedo-reload-watch", daemon=True
+        )
+        self._watch_thread.start()
+
+    def stop(self) -> None:
+        self._watch_stop.set()
+        t, self._watch_thread = self._watch_thread, None
+        if t is not None:
+            t.join(timeout=10.0)
+
+    def _watch_loop(self) -> None:
+        while not self._watch_stop.wait(self.watch_interval_s):
+            try:
+                self._watch_once()
+            except Exception:  # noqa: BLE001 — the watcher must outlive anything
+                log.exception("reload watch iteration failed")
+
+    def _watch_once(self) -> None:
+        changed: list[tuple[Path, tuple[float, int]]] = []
+        for p in self.candidate_paths():  # oldest -> newest
+            st = p.stat()
+            sig = (st.st_mtime, st.st_size)
+            if self._seen.get(str(p)) != sig:
+                # A manifest sidecar seals a finished write (the store
+                # renames then writes it); no sidecar yet = still landing.
+                if artifact_store.manifest_path(p).exists():
+                    changed.append((p, sig))
+        # Newest first; older changed candidates stay live fallbacks — if the
+        # newest fails its gates (and is quarantined away), the next one is
+        # attempted in the SAME sweep rather than being marked seen and
+        # silently dropped forever. Once something promotes, the remaining
+        # (older) candidates are superseded, not servable downgrades.
+        promoted = False
+        for p, sig in reversed(changed):
+            self._seen[str(p)] = sig
+            if promoted:
+                continue
+            report = self.request_reload(p)
+            promoted = report.get("outcome") == "promoted"
+        if promoted and self._error_baseline is not None:
+            # Let one interval of traffic land, then run the watchdog.
+            if not self._watch_stop.wait(self.watch_interval_s):
+                self.check_error_rate()

@@ -21,13 +21,24 @@ missing/cold ALS artifacts (``model=None``) -> popularity fallback; queue
 overflow -> :class:`~albedo_tpu.serving.batcher.QueueOverflow` (HTTP 429).
 Every degraded response carries ``"degraded": [reasons]`` and bumps
 ``albedo_degraded_total{reason=...}``.
+
+Live operations (PR 4): the model state a request reads is an immutable
+:class:`ModelGeneration` snapshot — model + batcher + pipeline ALS source,
+captured ONCE at request entry — so the hot-swap manager
+(``serving.reload``) can atomically promote a freshly validated generation
+(or roll one back) under live traffic without a request ever seeing half of
+each. Every response carries ``"generation"``; ``/healthz/ready`` reports
+the promoted generation, batcher warm state, and breaker states.
 """
 
 from __future__ import annotations
 
+import dataclasses
 import json
 import os
 import threading
+import time
+from concurrent.futures import TimeoutError as FutureTimeout
 
 import numpy as np
 import pandas as pd
@@ -35,7 +46,7 @@ import pandas as pd
 from albedo_tpu.datasets.ragged import csr_row, padded_rows
 from albedo_tpu.datasets.star_matrix import StarMatrix
 from albedo_tpu.models.als import ALSModel
-from albedo_tpu.serving.batcher import MicroBatcher
+from albedo_tpu.serving.batcher import BatcherClosed, DeadlineExceeded, MicroBatcher
 from albedo_tpu.serving.cache import TTLCache
 from albedo_tpu.serving.metrics import MetricsRegistry
 from albedo_tpu.serving.pipeline import (
@@ -43,6 +54,23 @@ from albedo_tpu.serving.pipeline import (
     StageDeadlines,
     TwoStagePipeline,
 )
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelGeneration:
+    """One immutable serving state: everything a request needs that a hot
+    swap replaces. Requests snapshot the CURRENT generation once at entry
+    and use only its members — items, scores, and the ``"generation"`` tag
+    in a response always come from the same model (no torn reads).
+    """
+
+    number: int
+    model: ALSModel | None
+    batcher: MicroBatcher | None
+    als_source: object | None  # BatchedALSSource/ALSRecommender for the pipeline
+    origin: str                # "boot" or the artifact path it was loaded from
+    validated: bool            # passed the reload validation gates (or boot)
+    promoted_at: float = 0.0
 
 
 class RecommendationService:
@@ -76,8 +104,9 @@ class RecommendationService:
         max_k: int = 500,
         item_block: int = 4096,
         warm: bool = False,
+        breaker_config=None,
+        breakers_enabled: bool = True,
     ):
-        self.model = model
         self.matrix = matrix
         self.repo_info = repo_info if repo_info is not None else pd.DataFrame()
         self.user_info = user_info if user_info is not None else pd.DataFrame()
@@ -87,6 +116,14 @@ class RecommendationService:
         self.item_block = int(item_block)
         self._closed = False
         self._close_lock = threading.Lock()
+        # Batcher construction parameters, kept so the hot-swap manager can
+        # build a candidate generation's batcher identically configured.
+        self._batching = bool(batching)
+        self._max_batch = int(max_batch)
+        self._max_queue = int(max_queue)
+        self._batch_window_ms = float(batch_window_ms)
+        self._warm = bool(warm)
+        self.reload_manager = None  # set by serving.reload.HotSwapManager
 
         if matrix is not None:
             self._indptr, self._cols, _ = matrix.csr()
@@ -94,60 +131,194 @@ class RecommendationService:
         else:
             self._indptr = self._cols = None
             max_hist = 0
+        self._max_hist = max_hist
         self._repo_names = (
             self.repo_info.set_index("repo_id")["repo_full_name"].to_dict()
             if "repo_full_name" in self.repo_info.columns
             else {}
         )
 
-        self.batcher: MicroBatcher | None = None
-        if batching and model is not None:
-            # Device-side exclusion table: the users' seen-item rows,
-            # -1-padded, uploaded once — requests then exclude by a device
-            # gather instead of per-request host slicing. Skewed datasets
-            # (one power user -> huge padded width) fall back to host rows;
-            # the cap is entries, i.e. 4 bytes each.
-            exclude_table = None
-            if matrix is not None and max_hist:
-                cap = int(os.environ.get("ALBEDO_SERVE_EXCL_TABLE_MAX", str(32 << 20)))
-                if matrix.n_users * max_hist <= cap:
-                    exclude_table = padded_rows(
-                        self._indptr, self._cols, np.arange(matrix.n_users)
-                    )
-            self.batcher = MicroBatcher(
-                model,
-                exclude_table=exclude_table,
-                excl_width=max_hist,
-                item_block=item_block,
-                max_batch=max_batch,
-                max_queue=max_queue,
-                window_ms=batch_window_ms,
-                metrics=self.metrics,
-            )
-            if warm:
-                self.batcher.warm(ks=(self.default_k,))
+        # Device-side exclusion table: the users' seen-item rows, -1-padded,
+        # computed once on the host and re-uploaded per generation's batcher
+        # (the matrix does not change across a model hot-swap). Skewed
+        # datasets (one power user -> huge padded width) fall back to host
+        # rows; the cap is entries, i.e. 4 bytes each.
+        self._exclude_table: np.ndarray | None = None
+        if batching and matrix is not None and max_hist:
+            cap = int(os.environ.get("ALBEDO_SERVE_EXCL_TABLE_MAX", str(32 << 20)))
+            if matrix.n_users * max_hist <= cap:
+                self._exclude_table = padded_rows(
+                    self._indptr, self._cols, np.arange(matrix.n_users)
+                )
 
         self.cache: TTLCache | None = (
             TTLCache(maxsize=cache_size, ttl=cache_ttl) if cache_ttl > 0 else None
         )
 
         self.pipeline: TwoStagePipeline | None = None
+        self._pipeline_owns_als = False
         if recommenders:
             sources = dict(recommenders)
-            if model is not None and matrix is not None and "als" not in sources:
-                if self.batcher is not None:
-                    sources["als"] = BatchedALSSource(
-                        self.batcher, matrix, exclude_seen=True, top_k=self.default_k
-                    )
-                else:
-                    from albedo_tpu.recommenders import ALSRecommender
-
-                    sources["als"] = ALSRecommender(
-                        model, matrix, exclude_seen=True, top_k=self.default_k
-                    )
+            # The live ALS source rides each ModelGeneration and joins the
+            # fan-out per request (pipeline extra_sources) — unless the
+            # caller registered an "als" source explicitly, which then wins.
+            self._pipeline_owns_als = "als" in sources
             self.pipeline = TwoStagePipeline(
-                sources, ranker=ranker, deadlines=deadlines, metrics=self.metrics
+                sources, ranker=ranker, deadlines=deadlines, metrics=self.metrics,
+                breaker_config=breaker_config, breakers_enabled=breakers_enabled,
             )
+
+        # Retired generations' batchers that have not been stopped yet: the
+        # incumbent stays fully serviceable after a promote (rollback target
+        # + in-flight requests holding its snapshot) until the manager
+        # retires it; close() sweeps whatever is left.
+        self._zombie_batchers: list[MicroBatcher] = []
+        self._gen_lock = threading.Lock()
+        self._generation = self.build_generation(
+            model,
+            number=1 if model is not None else 0,
+            origin="boot",
+            validated=model is not None,
+            warm=warm,
+        )
+        self.metrics.model_generation.set(self._generation.number)
+        self._max_generation = self._generation.number
+
+    # ------------------------------------------------- generation plumbing
+
+    @property
+    def generation(self) -> ModelGeneration:
+        return self._generation
+
+    def next_generation_number(self) -> int:
+        """A number no generation has ever carried. Candidate numbers must
+        never derive from the CURRENT generation: after a rollback
+        (2 -> back to 1) the next candidate would be "2" again, and a slow
+        request still holding the first gen-2 snapshot could write its model's
+        body under the second gen-2's cache key — the exact staleness the
+        generation-tagged key exists to make structurally impossible."""
+        with self._gen_lock:
+            return self._max_generation + 1
+
+    @property
+    def model(self) -> ALSModel | None:
+        return self._generation.model
+
+    @property
+    def batcher(self) -> MicroBatcher | None:
+        return self._generation.batcher
+
+    def build_generation(
+        self,
+        model: ALSModel | None,
+        number: int,
+        origin: str,
+        validated: bool,
+        warm: bool = False,
+    ) -> ModelGeneration:
+        """Assemble a serving state for ``model`` WITHOUT promoting it: the
+        batcher (same config as the incumbent's, warm-compiled off the
+        request path — same factor shapes reuse the incumbent's executables
+        via the AOT cache) and the pipeline ALS source."""
+        batcher = None
+        if self._batching and model is not None:
+            batcher = MicroBatcher(
+                model,
+                exclude_table=self._exclude_table,
+                excl_width=self._max_hist,
+                item_block=self.item_block,
+                max_batch=self._max_batch,
+                max_queue=self._max_queue,
+                window_ms=self._batch_window_ms,
+                metrics=self.metrics,
+            )
+            if warm:
+                batcher.warm(ks=(self.default_k,))
+        als_source = None
+        if (
+            self.pipeline is not None
+            and not self._pipeline_owns_als
+            and model is not None
+            and self.matrix is not None
+        ):
+            if batcher is not None:
+                als_source = BatchedALSSource(
+                    batcher, self.matrix, exclude_seen=True, top_k=self.default_k
+                )
+            else:
+                from albedo_tpu.recommenders import ALSRecommender
+
+                als_source = ALSRecommender(
+                    model, self.matrix, exclude_seen=True, top_k=self.default_k
+                )
+        return ModelGeneration(
+            number=int(number),
+            model=model,
+            batcher=batcher,
+            als_source=als_source,
+            origin=origin,
+            validated=validated,
+            promoted_at=time.time(),
+        )
+
+    def promote(self, gen: ModelGeneration) -> ModelGeneration:
+        """Atomically make ``gen`` the serving generation; returns the
+        displaced incumbent (left fully alive — it is the rollback target
+        and in-flight requests may still hold its snapshot). The result
+        cache is flushed: cached bodies carry the old generation tag."""
+        with self._gen_lock:
+            old = self._generation
+            self._generation = gen
+            self._max_generation = max(self._max_generation, gen.number)
+            if gen.batcher is not None and gen.batcher in self._zombie_batchers:
+                self._zombie_batchers.remove(gen.batcher)  # rollback revival
+            if old.batcher is not None and old.batcher is not gen.batcher:
+                self._zombie_batchers.append(old.batcher)
+        self.metrics.model_generation.set(gen.number)
+        if self.cache is not None:
+            self.cache.invalidate_all()
+        return old
+
+    def retire_batcher(self, batcher: MicroBatcher | None) -> None:
+        """Stop a displaced generation's batcher (drains in-flight work).
+        Called by the hot-swap manager once its post-swap checks pass."""
+        if batcher is None:
+            return
+        batcher.stop(drain=True)
+        with self._gen_lock:
+            if batcher in self._zombie_batchers:
+                self._zombie_batchers.remove(batcher)
+
+    def readiness(self) -> tuple[bool, dict]:
+        """(ready?, report) for ``/healthz/ready``: ready only once a
+        validated model generation is promoted. The report carries what an
+        operator needs to see first: generation, batcher warmth, breakers."""
+        gen = self._generation
+        ready = gen.model is not None and gen.validated
+        batcher = gen.batcher
+        report = {
+            "ready": ready,
+            "generation": gen.number,
+            "model_loaded": gen.model is not None,
+            "validated": gen.validated,
+            "origin": gen.origin,
+            "batcher": (
+                {
+                    "active": True,
+                    "warm": bool(batcher.warmed),
+                    "queue_depth": batcher._queue.qsize(),
+                    "mean_batch_size": round(batcher.mean_batch_size, 3),
+                }
+                if batcher is not None
+                else {"active": False}
+            ),
+            "breakers": (
+                self.pipeline.breaker_states() if self.pipeline is not None else {}
+            ),
+        }
+        if self.cache is not None:
+            report["cache"] = self.cache.stats()
+        return ready, report
 
     # ----------------------------------------------------------- lifecycle
 
@@ -158,8 +329,15 @@ class RecommendationService:
             if self._closed:
                 return
             self._closed = True
-        if self.batcher is not None:
-            self.batcher.stop(drain=True)
+        if self.reload_manager is not None:
+            self.reload_manager.stop()
+        gen = self._generation
+        if gen.batcher is not None:
+            gen.batcher.stop(drain=True)
+        with self._gen_lock:
+            zombies, self._zombie_batchers = self._zombie_batchers, []
+        for batcher in zombies:
+            batcher.stop(drain=True)
         if self.pipeline is not None:
             self.pipeline.close()
 
@@ -211,11 +389,12 @@ class RecommendationService:
 
         Kept verbatim as the parity baseline for the micro-batcher (and the
         ``batching=False`` serving mode)."""
+        gen = self._generation
         dense = self.matrix.users_of(np.array([user_id], dtype=np.int64))
         if dense[0] < 0:
             return {"user_id": user_id, "error": "unknown user", "items": []}
         excl = padded_rows(self._indptr, self._cols, dense) if exclude_seen else None
-        vals, idx = self.model.recommend(
+        vals, idx = gen.model.recommend(
             dense, k=k, exclude_idx=excl, item_block=self.item_block
         )
         ok = (idx[0] >= 0) & np.isfinite(vals[0])
@@ -223,38 +402,71 @@ class RecommendationService:
         return {
             "user_id": user_id,
             "k": k,
+            "generation": gen.number,
             "items": self._named_items(repo_ids, vals[0][ok]),
         }
 
-    def _recommend_batched(self, user_id: int, k: int, exclude_seen: bool) -> dict:
+    def _recommend_batched(
+        self,
+        gen: ModelGeneration,
+        user_id: int,
+        k: int,
+        exclude_seen: bool,
+        deadline: float | None = None,
+    ) -> dict:
         dense = self.matrix.users_of(np.array([user_id], dtype=np.int64))
         if dense[0] < 0:
             return {"user_id": user_id, "error": "unknown user", "items": []}
         exclude = None
         if exclude_seen:
             exclude = (
-                True if self.batcher.device_exclusion
+                True if gen.batcher.device_exclusion
                 else self._exclude_row(int(dense[0]))
             )
-        fut = self.batcher.submit(int(dense[0]), k, exclude)
-        vals, idx = fut.result(timeout=30.0)
+        fut = gen.batcher.submit(int(dense[0]), k, exclude, deadline=deadline)
+        timeout = 30.0
+        if deadline is not None:
+            timeout = max(0.05, deadline - time.monotonic())
+        try:
+            vals, idx = fut.result(timeout=timeout)
+        except FutureTimeout:
+            if deadline is None:
+                raise
+            # The client's deadline lapsed while the request queued: shed it
+            # here. A successful cancel keeps the worker from computing it
+            # AND means this side owns the accounting; a failed cancel means
+            # the worker already resolved it (its own shed counted there, a
+            # too-late success counts nowhere — the work was done).
+            if fut.cancel():
+                self.metrics.shed.inc()
+                self.metrics.deadline_shed.inc()
+            raise DeadlineExceeded(
+                "request deadline expired while queued",
+                retry_after_s=gen.batcher.retry_after_s(),
+            ) from None
         ok = (idx >= 0) & np.isfinite(vals)
         repo_ids = self.matrix.item_ids[idx[ok]]
         return {
             "user_id": user_id,
             "k": k,
+            "generation": gen.number,
             "items": self._named_items(repo_ids, vals[ok]),
         }
 
     def handle_recommend(
-        self, user_id: int, k=None, exclude_seen: bool = True
+        self,
+        user_id: int,
+        k=None,
+        exclude_seen: bool = True,
+        deadline: float | None = None,
     ) -> tuple[int, dict]:
         """Full engine path: cache -> (two-stage | batched ALS | fallback).
 
         Returns ``(http_status, body)``; raises
         :class:`~albedo_tpu.serving.batcher.QueueOverflow` for the HTTP
         layer's 429. Never returns a half-built body: every path ends in a
-        well-formed dict.
+        well-formed dict. ``deadline`` (monotonic timestamp) opts the
+        batched path into admission control.
         """
         user_id = int(user_id)
         k = self.clamp_k(k if k is not None else self.default_k)
@@ -264,7 +476,17 @@ class RecommendationService:
             # product shape) — clamp and SAY so, rather than claiming a k
             # the fusion cannot fill.
             k = min(k, self.default_k)
-        key = ("rec", user_id, k, bool(exclude_seen), self.pipeline is not None)
+        gen = self._generation
+
+        def cache_key(g):
+            # The generation tag is part of the cache key: a promoted swap
+            # must never answer from the displaced model's cached bodies
+            # (promote() also flushes, but the key makes staleness
+            # structurally impossible).
+            return ("rec", user_id, k, bool(exclude_seen),
+                    self.pipeline is not None, g.number)
+
+        key = cache_key(gen)
         if self.cache is not None:
             hit = self.cache.get(key)
             if hit is not None:
@@ -272,16 +494,48 @@ class RecommendationService:
                 return hit
             self.metrics.cache_misses.inc()
 
-        status, body = self._compute(user_id, k, exclude_seen)
+        try:
+            status, body = self._compute(gen, user_id, k, exclude_seen, deadline)
+        except BatcherClosed:
+            # The snapshot lost a race with a retirement (its batcher was
+            # stopped between our read and the submit). The CURRENT
+            # generation is alive by construction — retry once against it,
+            # and re-key the cache write to the generation that actually
+            # answered (a body cached under the displaced key could outlive
+            # a later rollback to that very generation number).
+            gen = self._generation
+            key = cache_key(gen)
+            status, body = self._compute(gen, user_id, k, exclude_seen, deadline)
+        self.metrics.generation_requests.inc(generation=str(gen.number))
         if self.cache is not None and status == 200 and not body.get("degraded"):
             self.cache.put(key, (status, body), user_id=user_id)
         return status, body
 
-    def _compute(self, user_id: int, k: int, exclude_seen: bool) -> tuple[int, dict]:
+    def _compute(
+        self,
+        gen: ModelGeneration,
+        user_id: int,
+        k: int,
+        exclude_seen: bool,
+        deadline: float | None = None,
+    ) -> tuple[int, dict]:
+        # Admission control, every path: a request whose deadline lapsed
+        # before compute started (queued in the HTTP pool, or retried across
+        # a generation swap) is shed here rather than computed-then-late.
+        # Nothing was submitted yet, so this side owns the accounting.
+        if deadline is not None and time.monotonic() >= deadline:
+            self.metrics.shed.inc()
+            self.metrics.deadline_shed.inc()
+            raise DeadlineExceeded(
+                "request deadline expired while queued",
+                retry_after_s=(
+                    gen.batcher.retry_after_s() if gen.batcher is not None else None
+                ),
+            )
         # Cold/missing ALS artifacts: the popularity fallback keeps answering.
         # The degraded counter counts ANSWERED degraded requests only — the
         # no-fallback 503 below is an error, not a degradation.
-        if self.model is None:
+        if gen.model is None:
             # Any registered sources (popularity and friends) live in the
             # pipeline — a recommenders dict always constructs one, so the
             # pipeline IS the fallback plane. Degraded counts answered
@@ -293,25 +547,32 @@ class RecommendationService:
                     "items": [],
                 }
             self.metrics.degraded.inc(reason="cold_artifacts")
-            out = self.pipeline.recommend(user_id, k, exclude_seen=exclude_seen)
+            out = self.pipeline.recommend(
+                user_id, k, exclude_seen=exclude_seen, deadline=deadline
+            )
             out.setdefault("degraded", []).insert(0, "cold_artifacts")
-            return 200, self._pipeline_body(user_id, k, out)
+            return 200, self._pipeline_body(gen, user_id, k, out)
 
         if self.pipeline is not None:
-            out = self.pipeline.recommend(user_id, k, exclude_seen=exclude_seen)
-            return 200, self._pipeline_body(user_id, k, out)
+            extra = {"als": gen.als_source} if gen.als_source is not None else None
+            out = self.pipeline.recommend(
+                user_id, k, exclude_seen=exclude_seen, extra_sources=extra,
+                deadline=deadline,
+            )
+            return 200, self._pipeline_body(gen, user_id, k, out)
 
-        if self.batcher is not None:
-            body = self._recommend_batched(user_id, k, exclude_seen)
+        if gen.batcher is not None:
+            body = self._recommend_batched(gen, user_id, k, exclude_seen, deadline)
         else:
             body = self.recommend(user_id, k=k, exclude_seen=exclude_seen)
         return (404 if body.get("error") else 200), body
 
-    def _pipeline_body(self, user_id: int, k: int, out: dict) -> dict:
+    def _pipeline_body(self, gen: ModelGeneration, user_id: int, k: int, out: dict) -> dict:
         items = out.get("items", [])
         return {
             "user_id": user_id,
             "k": k,
+            "generation": gen.number,
             "stage": out.get("stage"),
             "degraded": out.get("degraded", []),
             "items": [

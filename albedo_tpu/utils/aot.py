@@ -15,11 +15,40 @@ compilation (VERDICT r5 weak #1). Two layers kill it:
    compile's program — pinned by the round-trip parity test.
 
 Kill switch: ``ALBEDO_ALS_AOT=0`` disables the disk layer (the LRU stays).
+
+**Verified cross-process reuse** (PR 4). Serialized-executable reuse on
+some CPU/jaxlib combinations reproduced DIFFERENT numerics than a fresh
+compile of the same program — the PR 3 kill-resume drills had to pin
+``--no-compilation-cache``. Root cause (PR 4 drills): the persistent XLA
+cache's deserialized executables for CUSTOM-CALL programs (the CPU LAPACK
+Cholesky) corrupt numerics **nondeterministically** (sub-1e-3 drift up to
+all-NaN factors on real inputs, while reproducing probe outputs — so no
+verification can make that reuse safe). Three scoped defenses:
+
+1. **Custom-call programs never reuse serialized executables at ANY
+   layer**: already excluded from the ``jax.export`` disk cache, they now
+   also compile with the persistent XLA cache bypassed. TPU lowers the
+   same solves to pure HLO and keeps the full cache stack; CPU Cholesky
+   pays a per-process compile — correctness over warmth.
+2. **Output-fingerprint self-check on export round-trips**: at export time
+   the fresh-compiled executable runs once on a deterministic probe input
+   (derived from argument shapes/dtypes; varied index patterns — an
+   all-equal batch is invariant to exactly the stride/layout bugs corrupt
+   executables exhibit) and a SHA-256 of its output bytes lands in a
+   ``.fp`` sidecar; a deserializing process replays the probe and, on
+   mismatch, deletes the export and recompiles
+   (``albedo_aot_fingerprint_mismatches_total{name=}``).
+3. **Export-failed programs** (custom-call status unknown) get the same
+   probe fingerprint across the XLA-cache boundary: mismatch recompiles
+   with the cache bypassed.
+
+``ALBEDO_AOT_FINGERPRINT=0`` disables all three (the pre-PR-4 behavior).
 """
 
 from __future__ import annotations
 
 import hashlib
+import json
 import logging
 import os
 import threading
@@ -67,6 +96,8 @@ class LRUCache:
 
 
 _EXECUTABLES = LRUCache(maxsize=int(os.environ.get("ALBEDO_AOT_MEMORY_SLOTS", "8")))
+# Serializes the XLA-cache bypass toggle (see _compile_bypassing_xla_cache).
+_BYPASS_LOCK = threading.Lock()
 
 
 def reset_memory_cache() -> None:
@@ -88,6 +119,111 @@ def export_dir() -> Path:
 
 def signature_digest(key_parts: tuple) -> str:
     return hashlib.sha256(repr(key_parts).encode("utf-8")).hexdigest()[:24]
+
+
+def fingerprint_enabled() -> bool:
+    return os.environ.get("ALBEDO_AOT_FINGERPRINT", "1") != "0"
+
+
+def _fingerprint_path(path: Path) -> Path:
+    return path.with_name(path.name + ".fp")
+
+
+def _probe_leaf(leaf):
+    """A deterministic stand-in with ``leaf``'s shape/dtype. Integer leaves
+    get a small VARIED pattern (``arange % 7`` — XLA gathers clamp and
+    scatters drop out-of-range indices, so small values are always safe;
+    varied values matter because an all-equal batch is invariant to exactly
+    the batched-solve stride/layout bugs a corrupt executable exhibits, and
+    a zeros probe provably missed the CPU kill-resume drift). Booleans stay
+    zeros (masks: the empty-bucket path is shape-safe everywhere). Floats
+    get a fixed repeating POSITIVE ramp in [0.25, 0.75) — any value drift
+    shows in the output bytes, and scalar hyperparameters (regularization,
+    confidence) stay in well-posed territory so solver probes exercise the
+    real numeric path rather than a NaN fill. Only shape/dtype are read (no
+    device download)."""
+    import numpy as np
+
+    shape = getattr(leaf, "shape", None)
+    dtype = getattr(leaf, "dtype", None)
+    if shape is None or dtype is None:
+        return leaf  # python scalar static-alike: already deterministic
+    dtype = np.dtype(dtype)
+    size = int(np.prod(shape)) if shape else 1
+    if dtype.kind == "b":
+        return np.zeros(shape, dtype)
+    if dtype.kind in "iu":
+        if not shape:
+            # 0-d int leaves are traced COUNTS (n_iter, steps): probe with 2
+            # so the loop body the fingerprint exists to verify actually
+            # executes (a zero count would fingerprint only the prologue).
+            return np.asarray(2, dtype)
+        return (np.arange(max(size, 1))[:size] % 7).reshape(shape).astype(dtype)
+    ramp = (np.arange(max(size, 1)) % 61).astype(np.float64) / 122.0 + 0.25
+    return ramp[:size].reshape(shape).astype(dtype)
+
+
+def _xla_persistent_cache_engaged() -> bool:
+    """True when compiles can be served from the on-disk XLA compilation
+    cache — the only way a CUSTOM-CALL program's executable crosses process
+    boundaries (such programs never enter the jax.export disk layer)."""
+    import jax
+
+    try:
+        return bool(jax.config.jax_enable_compilation_cache) and bool(
+            jax.config.jax_compilation_cache_dir
+        )
+    except AttributeError:  # pragma: no cover — much older jax
+        return False
+
+
+def _compile_bypassing_xla_cache(jitted, args, dyn_kwargs, static_kwargs):
+    """A provably-fresh compile: the persistent XLA cache is switched off
+    for just this lower+compile, then restored.
+
+    jax 0.4.x latches the is-cache-used decision process-globally on first
+    compile, so flipping the config alone is a silent no-op — the latch must
+    be reset around the toggle (and again after, so every other program
+    keeps its cache). The toggle is serialized under a module lock:
+    overlapping bypassers would otherwise save each other's mid-toggle
+    state and could leave the cache disabled process-wide. A concurrent
+    NON-bypass compile during the window at worst misses the cache once
+    (slower, never wrong)."""
+    import jax
+
+    try:
+        from jax._src.compilation_cache import reset_cache as _reset_latch
+    except (ImportError, AttributeError):  # pragma: no cover — future jax
+        _reset_latch = lambda: None  # noqa: E731
+
+    with _BYPASS_LOCK:
+        prev = bool(jax.config.jax_enable_compilation_cache)
+        try:
+            jax.config.update("jax_enable_compilation_cache", False)
+            _reset_latch()
+            return jitted.lower(*args, **dyn_kwargs, **static_kwargs).compile()
+        finally:
+            jax.config.update("jax_enable_compilation_cache", prev)
+            _reset_latch()
+
+
+def _output_fingerprint(compiled, args: tuple, dyn_kwargs: dict) -> str:
+    """Run ``compiled`` on the deterministic probe and hash the raw output
+    bytes (shape + dtype + buffer; NaNs compare by representation)."""
+    import jax
+    import numpy as np
+
+    probe_args, probe_kwargs = jax.tree_util.tree_map(
+        _probe_leaf, (tuple(args), dict(dyn_kwargs))
+    )
+    out = compiled(*probe_args, **probe_kwargs)
+    h = hashlib.sha256()
+    for leaf in jax.tree_util.tree_leaves(out):
+        arr = np.asarray(leaf)
+        h.update(str(arr.shape).encode())
+        h.update(str(arr.dtype).encode())
+        h.update(arr.tobytes())
+    return h.hexdigest()
 
 
 def _has_custom_calls(exported) -> bool:
@@ -183,7 +319,33 @@ def persistent_aot_executable(
             if _has_custom_calls(restored):
                 raise ValueError("serialized module contains custom calls")
             compiled = jax.jit(restored.call).lower(*args, **dyn_kwargs).compile()
-            source = "disk"
+            # Self-check: the deserialized executable must reproduce the
+            # exporting process's probe output bit-for-bit. A mismatch means
+            # some cache layer handed back a divergent program — discard the
+            # export and recompile rather than serve drifted numerics.
+            fp_path = _fingerprint_path(path)
+            if fingerprint_enabled() and fp_path.exists():
+                expected = json.loads(fp_path.read_text()).get("sha256")
+                got = _output_fingerprint(compiled, args, dyn_kwargs)
+                if got != expected:
+                    from albedo_tpu.utils import events
+
+                    events.aot_fingerprint_mismatches.inc(name=name)
+                    log.warning(
+                        "AOT export %s output fingerprint mismatch "
+                        "(%s != %s); discarding and recompiling",
+                        path.name, got[:12], str(expected)[:12],
+                    )
+                    for stale in (path, fp_path):
+                        try:
+                            stale.unlink()
+                        except OSError:
+                            pass
+                    compiled = None
+                else:
+                    source = "disk"
+            else:
+                source = "disk"
         except Exception as e:  # noqa: BLE001
             # Stale/incompatible blob: fall through to a fresh compile, but
             # say so — a silently dead disk layer reads exactly like a cold
@@ -194,12 +356,14 @@ def persistent_aot_executable(
     if compiled is None:
         source = "compile"
         exported = None
+        custom_calls: bool | None = None  # None = export failed, can't tell
         if path is not None:
             try:
                 from jax import export as jax_export
 
                 exported = jax_export.export(jitted)(*args, **dyn_kwargs, **static_kwargs)
-                if _has_custom_calls(exported):
+                custom_calls = _has_custom_calls(exported)
+                if custom_calls:
                     log.debug("%s embeds custom calls; memory cache only", name)
                     exported = None  # not round-trip-safe: memory cache only
             except Exception as e:  # noqa: BLE001
@@ -210,15 +374,137 @@ def persistent_aot_executable(
             # Compile the SAME StableHLO a later disk hit will deserialize:
             # fresh-compile and round-trip runs execute the identical program.
             compiled = jax.jit(exported.call).lower(*args, **dyn_kwargs).compile()
+            wrote_export = False
             try:
                 tmp = path.with_name(path.name + f".tmp{os.getpid()}")
                 path.parent.mkdir(parents=True, exist_ok=True)
                 tmp.write_bytes(exported.serialize())
                 os.replace(tmp, path)
+                wrote_export = True
             except OSError:
                 pass  # cache write is best-effort, never fatal
+            if wrote_export and fingerprint_enabled():
+                # Record what THIS (fresh-compiled) executable computes on
+                # the deterministic probe; deserializing processes must
+                # reproduce it or recompile. A probe that cannot run (any
+                # error, not just IO — e.g. a mesh-committed program
+                # rejecting synthetic host inputs) must not crash the job,
+                # but it also must not leave a sidecar-less export behind
+                # for later processes to trust unverified.
+                try:
+                    fp = _output_fingerprint(compiled, args, dyn_kwargs)
+                    fp_path = _fingerprint_path(path)
+                    fp_tmp = fp_path.with_name(fp_path.name + f".tmp{os.getpid()}")
+                    fp_tmp.write_text(json.dumps({"sha256": fp}))
+                    os.replace(fp_tmp, fp_path)
+                except Exception as e:  # noqa: BLE001
+                    log.warning(
+                        "probe fingerprint of %s failed (%r); removing the "
+                        "unverifiable export", name, e,
+                    )
+                    try:
+                        path.unlink()
+                    except OSError:
+                        pass
+        elif custom_calls and fingerprint_enabled() and _xla_persistent_cache_engaged():
+            # Known custom-call program (the CPU Cholesky fit). Custom calls
+            # are the unstable part of EVERY serialization layer, not just
+            # jax.export: the persistent XLA cache's deserialized executables
+            # for this program class corrupted numerics NONDETERMINISTICALLY
+            # on CPU/jaxlib 0.4.x (sub-1e-3 drift up to all-NaN factors —
+            # root-caused by the PR 4 kill-resume drills; a probe fingerprint
+            # passes and the same executable then NaNs on real data, so
+            # verification cannot make this reuse safe). Do what we already
+            # do at the export layer — refuse serialized reuse — and compile
+            # fresh with the XLA cache bypassed. TPU lowers these solves to
+            # pure HLO and keeps the full cache stack.
+            log.debug(
+                "%s embeds custom calls; compiling fresh (persistent XLA "
+                "cache bypassed for this program)", name
+            )
+            compiled = _compile_bypassing_xla_cache(
+                jitted, args, dyn_kwargs, static_kwargs
+            )
         else:
             compiled = jitted.lower(*args, **dyn_kwargs, **static_kwargs).compile()
+            # Export-failed programs (custom-call status unknown) still ride
+            # the persistent XLA cache across processes — guard that reuse
+            # with the probe fingerprint: the first process (cold cache)
+            # records the fresh compile's probe output; a later process
+            # whose cache-fed executable cannot reproduce it recompiles
+            # with the XLA cache bypassed.
+            if (
+                fingerprint_enabled()
+                and disk_cache_enabled()
+                and _xla_persistent_cache_engaged()
+            ):
+                fp_path = export_dir() / f"{name}-{digest}.fp"
+                got = None
+                try:
+                    got = _output_fingerprint(compiled, args, dyn_kwargs)
+                except Exception as e:  # noqa: BLE001 — probe must not kill the job
+                    log.warning(
+                        "probe fingerprint of %s failed (%r); skipping "
+                        "cross-process verification for this program", name, e,
+                    )
+                try:
+                    if got is None:
+                        pass
+                    elif fp_path.exists():
+                        expected = json.loads(fp_path.read_text()).get("sha256")
+                        if got != expected:
+                            from albedo_tpu.utils import events
+
+                            events.aot_fingerprint_mismatches.inc(name=name)
+                            log.warning(
+                                "XLA-cached compile of %s diverges from the "
+                                "recorded fresh-compile fingerprint (%s != "
+                                "%s); recompiling with the compilation "
+                                "cache bypassed",
+                                name, got[:12], str(expected)[:12],
+                            )
+                            compiled = _compile_bypassing_xla_cache(
+                                jitted, args, dyn_kwargs, static_kwargs
+                            )
+                    else:
+                        # Baseline creation must be provably fresh: THIS
+                        # process's compile may itself have been fed by a
+                        # warm persistent cache (a pre-fingerprint process
+                        # can have left a corrupt deserialized executable),
+                        # and recording its probe output would make every
+                        # later verification vacuous — the corruption would
+                        # BE the baseline. Pay one bypassed compile to
+                        # anchor it, and hold ourselves to the same check.
+                        try:
+                            fresh = _compile_bypassing_xla_cache(
+                                jitted, args, dyn_kwargs, static_kwargs
+                            )
+                            baseline = _output_fingerprint(fresh, args, dyn_kwargs)
+                        except Exception as e:  # noqa: BLE001
+                            log.warning(
+                                "fresh baseline compile of %s failed (%r); "
+                                "skipping cross-process verification", name, e,
+                            )
+                        else:
+                            fp_path.parent.mkdir(parents=True, exist_ok=True)
+                            fp_tmp = fp_path.with_name(
+                                fp_path.name + f".tmp{os.getpid()}"
+                            )
+                            fp_tmp.write_text(json.dumps({"sha256": baseline}))
+                            os.replace(fp_tmp, fp_path)
+                            if got != baseline:
+                                from albedo_tpu.utils import events
+
+                                events.aot_fingerprint_mismatches.inc(name=name)
+                                log.warning(
+                                    "XLA-cached compile of %s diverges from "
+                                    "the fresh-compile baseline (%s != %s); "
+                                    "serving the bypassed compile",
+                                    name, got[:12], baseline[:12],
+                                )
+                                compiled = fresh
+                except (OSError, ValueError):
+                    pass  # fingerprint bookkeeping is best-effort
     compile_s = time.perf_counter() - t0
 
     _EXECUTABLES.put(mem_key, compiled)

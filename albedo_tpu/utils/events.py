@@ -78,6 +78,14 @@ class Counter:
         with self._lock:
             return sum(self._values.values())
 
+    def samples(self) -> list[tuple[dict, float]]:
+        """Every (labels, value) child — consumers that need per-label
+        arithmetic (the reload error-rate watchdog) read this instead of
+        poking the internals."""
+        with self._lock:
+            items = list(self._values.items())
+        return [(dict(zip(self.label_names, key)), v) for key, v in items]
+
     def clear(self) -> None:
         """Drop all samples — test isolation for process-global counters."""
         with self._lock:
@@ -217,4 +225,10 @@ faults_fired = global_counter(
     "albedo_faults_fired_total",
     "Injected faults fired by the utils.faults harness, by site.",
     ("site",),
+)
+aot_fingerprint_mismatches = global_counter(
+    "albedo_aot_fingerprint_mismatches_total",
+    "Serialized AOT executables discarded because their probe-output "
+    "fingerprint did not match the exporting process's record.",
+    ("name",),
 )

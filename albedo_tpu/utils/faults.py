@@ -30,10 +30,12 @@ counted in the process-global ``albedo_faults_fired_total{site=...}``
 (``utils.events``) so chaos runs can assert — from `/metrics` — that the
 fault actually happened.
 
-Site catalog (kept in ARCHITECTURE.md "Fault tolerance"): ``artifact.load``,
+Site catalog (kept in ARCHITECTURE.md "Fault tolerance", linted against the
+code by ``tests/test_fault_sites.py``): ``artifact.load``,
 ``artifact.save``, ``checkpoint.save``, ``checkpoint.restore``,
-``crawler.transport``, ``pipeline.stage``, ``serving.source.<name>``,
-``serving.rank``.
+``crawler.transport``, ``pipeline.stage``, ``pipeline.stage.<name>``,
+``serving.source.<name>``, ``serving.rank``, ``serving.breaker.<name>``,
+``reload.load``, ``reload.validate``.
 """
 
 from __future__ import annotations

@@ -14,6 +14,12 @@ Servers that SAY when to come back are honored exactly: raise
 number, not the backoff curve's. Every performed retry is counted in the
 process-global ``albedo_retry_attempts_total{site=...}`` counter
 (``utils.events``) so `/metrics` shows which dependency is flapping.
+
+The serving circuit breakers (``serving.breaker``) ride the SAME
+:class:`RetryPolicy` schedule for their open -> half-open reopen timing
+(base/multiplier/cap walked up per consecutive trip), with equal jitter
+instead of full jitter — a breaker drawing a ~0 s delay would probe a dead
+dependency exactly when it should back off.
 """
 
 from __future__ import annotations
@@ -67,9 +73,18 @@ class RetryPolicy:
     deadline_s: float | None = None
     jitter: bool = True  # full jitter; False = deterministic caps (tests)
 
+    def cap(self, attempt: int) -> float:
+        """The (un-jittered) backoff ceiling after the ``attempt``-th failure
+        (0-based) — the one place the curve is defined. The exponent guard
+        keeps a counter that keeps climbing (e.g. a breaker open for hours)
+        from overflowing float range long after ``max_delay_s`` took over."""
+        return min(
+            self.max_delay_s, self.base_s * (self.multiplier ** min(attempt, 64))
+        )
+
     def delay(self, attempt: int, rng: random.Random) -> float:
         """Backoff delay after the ``attempt``-th failure (0-based)."""
-        cap = min(self.max_delay_s, self.base_s * (self.multiplier ** attempt))
+        cap = self.cap(attempt)
         return rng.uniform(0.0, cap) if self.jitter else cap
 
 

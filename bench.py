@@ -1190,6 +1190,89 @@ def serving_bench() -> dict:
             "micro_batched_rps": [r["rps"] for r in bat_trials],
         }
 
+        # --- live-ops measurements (PR 4) --------------------------------
+        # Admission control: a burst of 1 ms-deadline requests against the
+        # loaded engine — every answer must be either a served 200 or a
+        # shed (DeadlineExceeded/QueueOverflow -> the HTTP 429 path), and
+        # the record shows the split plus the Retry-After pricing.
+        from albedo_tpu.serving import QueueOverflow as _QO
+
+        burst = int(os.environ.get("ALBEDO_SERVE_DEADLINE_BURST", "160"))
+        served = [0] * concurrency
+        shed = [0] * concurrency
+
+        def deadline_client(ci: int) -> None:
+            rng = np.random.default_rng(5000 + ci)
+            for _ in range(burst // concurrency):
+                uid = int(user_ids[int(rng.integers(0, len(user_ids)))])
+                deadline = time.monotonic() + 1e-3
+                try:
+                    status, _ = batched.handle_recommend(uid, k=k, deadline=deadline)
+                    if status == 200:
+                        served[ci] += 1
+                except _QO:
+                    shed[ci] += 1
+
+        threads = [
+            _threading.Thread(target=deadline_client, args=(ci,), daemon=True)
+            for ci in range(concurrency)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        record["admission"] = {
+            "deadline_ms": 1,
+            "burst": burst,
+            "served": int(sum(served)),
+            "shed_429": int(sum(shed)),
+            "deadline_shed_total": int(batched.metrics.deadline_shed.value()),
+            "retry_after_estimate_s": round(batched.batcher.retry_after_s(), 3),
+        }
+
+        # Validated hot-swap under load: the same factors re-land as a new
+        # generation mid-traffic. run_load's zero-error contract doubles as
+        # the continuity assertion — no request may fail across the swap —
+        # and the record prices the full gate+warm+promote pipeline.
+        from albedo_tpu.datasets.artifacts import (
+            artifact_path,
+            manifest_path,
+            save_pickle,
+            write_manifest,
+        )
+        from albedo_tpu.serving import HotSwapManager
+
+        swap_path = artifact_path("bench-serve-alsModel.pkl")
+        save_pickle(swap_path, model.to_arrays())
+        write_manifest(swap_path)
+        mgr = HotSwapManager(batched, probe_users=8, probe_k=k)
+        swap_result: dict = {}
+
+        def _swap() -> None:
+            t0s = time.perf_counter()
+            swap_result["report"] = mgr.request_reload(swap_path)
+            swap_result["reload_s"] = round(time.perf_counter() - t0s, 3)
+
+        swap_timer = _threading.Timer(duration_s / 2, _swap)
+        swap_timer.start()
+        swap_load = run_load(batched, "hot_swap")
+        swap_timer.join(timeout=120)
+        outcome = swap_result.get("report", {}).get("outcome")
+        if outcome != "promoted":
+            fail("serving_hot_swap", f"swap under load did not promote: {swap_result}")
+        record["hot_swap"] = {
+            "outcome": outcome,
+            "reload_s": swap_result["reload_s"],
+            "generation": swap_result["report"].get("generation"),
+            "rps_during_swap": swap_load["rps"],
+            "p99_ms_during_swap": swap_load["p99_ms"],
+        }
+        for p in (swap_path, manifest_path(swap_path)):
+            try:
+                p.unlink()
+            except OSError:
+                pass
+
     record["value"] = bat["rps"]
     record["per_request"] = per
     record["micro_batched"] = bat

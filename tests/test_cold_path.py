@@ -117,6 +117,47 @@ def test_aot_export_roundtrip_identical_factors():
     np.testing.assert_array_equal(first.item_factors, second.item_factors)
 
 
+def test_aot_fingerprint_mismatch_discards_export_and_recompiles():
+    """The output-fingerprint self-check: an export whose deserialized
+    executable does not reproduce the recorded probe output is discarded
+    (file deleted, mismatch counted) and the program recompiles fresh —
+    divergent cached executables can never serve drifted numerics. A
+    tampered sidecar stands in for a genuinely divergent executable."""
+    import json as _json
+
+    from albedo_tpu.utils import events
+    from albedo_tpu.utils.aot import export_dir
+
+    m = synthetic_stars(n_users=90, n_items=60, mean_stars=6, seed=23)
+    als = ImplicitALS(rank=4, max_iter=3, seed=7, solver="cg")
+    first = als.fit(m)
+    assert als.last_fit_report["compile_source"] == "compile"
+    exports = list(export_dir().glob("als_init_fit_fused-*.jaxexport"))
+    sidecars = list(export_dir().glob("als_init_fit_fused-*.jaxexport.fp"))
+    assert exports and sidecars  # the export records its probe fingerprint
+
+    # Tamper the recorded fingerprint: the next process's self-check must
+    # refuse the (now unprovable) executable.
+    sidecars[0].write_text(_json.dumps({"sha256": "0" * 64}))
+    reset_memory_cache()
+    als2 = ImplicitALS(rank=4, max_iter=3, seed=7, solver="cg")
+    second = als2.fit(m)
+    assert als2.last_fit_report["compile_source"] == "compile"  # not "disk"
+    assert events.aot_fingerprint_mismatches.total() >= 1
+    np.testing.assert_array_equal(first.user_factors, second.user_factors)
+
+    # The discarded export was rewritten by the fresh compile, with a new
+    # fingerprint — and a third acquisition trusts it again.
+    assert list(export_dir().glob("als_init_fit_fused-*.jaxexport"))
+    new_fp = _json.loads(sidecars[0].read_text())["sha256"]
+    assert new_fp != "0" * 64
+    reset_memory_cache()
+    als3 = ImplicitALS(rank=4, max_iter=3, seed=7, solver="cg")
+    third = als3.fit(m)
+    assert als3.last_fit_report["compile_source"] == "disk"
+    np.testing.assert_array_equal(first.user_factors, third.user_factors)
+
+
 def test_aot_skips_disk_for_custom_call_programs():
     """On CPU the Cholesky solve lowers to a LAPACK custom call, which is not
     round-trip-safe (executing a deserialized copy in a fresh process can

@@ -148,6 +148,28 @@ def test_stop_drains_pending_work(artifacts):
         batcher.submit(0, 5)
 
 
+def test_deadline_expired_request_is_shed_not_computed(artifacts):
+    """Admission control at the batcher: a request whose deadline already
+    passed when the worker reaches it fails with DeadlineExceeded; one with
+    headroom is served normally from the same queue."""
+    from albedo_tpu.serving import DeadlineExceeded
+
+    _, matrix, model = artifacts
+    batcher = MicroBatcher(model, window_ms=0.0)
+    try:
+        dead = batcher.submit(0, 5, deadline=time.monotonic() - 0.01)
+        live = batcher.submit(1, 5, deadline=time.monotonic() + 30.0)
+        with pytest.raises(DeadlineExceeded) as ei:
+            dead.result(timeout=10)
+        assert isinstance(ei.value, QueueOverflow)  # same 429 contract
+        assert 1.0 <= ei.value.retry_after_s <= 30.0
+        vals, idx = live.result(timeout=10)
+        assert vals.shape == (5,) and idx.shape == (5,)
+        assert 1.0 <= batcher.retry_after_s() <= 30.0
+    finally:
+        batcher.stop()
+
+
 def test_warm_precompiles_ladder(artifacts):
     _, matrix, model = artifacts
     batcher = MicroBatcher(model, max_batch=4, window_ms=0.0)

@@ -245,6 +245,112 @@ def test_graceful_shutdown_leaks_no_threads(artifacts):
     assert not leaked, f"leaked threads: {leaked}"
 
 
+def test_readiness_endpoint_ready_and_not_ready(artifacts):
+    tables, matrix, model = artifacts
+    svc = RecommendationService(model, matrix)
+    with serve(svc, port=0) as handle:
+        status, body = _get(handle, "/healthz/ready")
+        assert status == 200
+        assert body["ready"] is True and body["generation"] == 1
+        assert body["batcher"]["active"] is True
+        status, body = _get(handle, "/healthz/live")
+        assert status == 200 and body["ok"] is True
+
+    # No validated model promoted: alive but NOT ready (503 tells the LB
+    # to keep traffic away while degradation keeps direct callers served).
+    cold = RecommendationService(None, matrix)
+    with serve(cold, port=0) as handle:
+        status, _ = _get(handle, "/healthz")
+        assert status == 200  # liveness unaffected
+        status, body = _get(handle, "/healthz/ready")
+        assert status == 503
+        assert body["ready"] is False and body["model_loaded"] is False
+
+
+def test_misspelled_healthz_subpath_is_404(server):
+    """/healthz/<typo> must fail loudly (regression: it returned the 200
+    liveness body, so a misconfigured readinessProbe — /healthz/readiness,
+    /healthz/read — would route traffic to a cold, unready process)."""
+    handle, _ = server
+    for typo in ("/healthz/readiness", "/healthz/read", "/healthz/live/x"):
+        status, body = _get(handle, typo)
+        assert status == 404 and "not found" in body["error"], typo
+
+
+def test_admin_reload_without_manager_is_503(server):
+    handle, _ = server
+    status, body = _post(handle, "/admin/reload")
+    assert status == 503 and "no hot-swap manager" in body["error"]
+
+
+def test_admin_reload_rejects_path_names(server):
+    """Traversal/absolute artifact params are a 400 before they reach the
+    reload machinery (which would unpickle and quarantine-rename the file)."""
+    handle, _ = server
+    for bad in ("..%2F..%2Fetc%2Fpasswd", "%2Fetc%2Fpasswd", ".hidden"):
+        status, body = _post(handle, f"/admin/reload?artifact={bad}")
+        assert status == 400 and "bare artifact file name" in body["error"], bad
+
+
+def test_deadline_shed_is_429_with_retry_after(artifacts):
+    """Admission control: a request whose deadline expires while queued is
+    shed (429 + Retry-After), not computed."""
+    tables, matrix, model = artifacts
+    svc = RecommendationService(model, matrix, batch_window_ms=0.0)
+    release = threading.Event()
+    entered = threading.Event()
+    real_execute = svc.batcher._execute
+
+    def slow_execute(k, mode, reqs):
+        entered.set()
+        release.wait(timeout=30)
+        real_execute(k, mode, reqs)
+
+    svc.batcher._execute = slow_execute
+    try:
+        with serve(svc, port=0) as handle:
+            uid = int(matrix.user_ids[0])
+            results = []
+
+            def hit(path):
+                results.append((path, _get(handle, path)))
+
+            # First request wedges the worker inside its batch...
+            t0 = threading.Thread(target=hit, args=(f"/recommend/{uid}?k=3",))
+            t0.start()
+            assert entered.wait(timeout=10)
+            # ...the second carries a 100ms deadline and queues behind it.
+            t1 = threading.Thread(
+                target=hit, args=(f"/recommend/{uid}?k=3&deadline_ms=100",)
+            )
+            t1.start()
+            time.sleep(0.3)  # let the deadline lapse while queued
+            release.set()
+            t0.join(timeout=30)
+            t1.join(timeout=30)
+            by_path = {p: (code, body) for p, (code, body) in results}
+            code, body = by_path[f"/recommend/{uid}?k=3"]
+            assert code == 200 and body["items"]
+            code, body = by_path[f"/recommend/{uid}?k=3&deadline_ms=100"]
+            assert code == 429 and "deadline" in body["error"]
+            assert svc.metrics.deadline_shed.value() == 1
+            assert svc.metrics.shed.value() >= 1
+            host, port = handle.server_address[:2]
+            with urllib.request.urlopen(
+                f"http://{host}:{port}/metrics", timeout=30
+            ) as r:
+                assert "albedo_deadline_shed_total 1" in r.read().decode()
+    finally:
+        release.set()
+
+
+def test_deadline_generous_enough_is_served(server):
+    handle, matrix = server
+    uid = int(matrix.user_ids[2])
+    status, body = _get(handle, f"/recommend/{uid}?k=3&deadline_ms=30000")
+    assert status == 200 and body["items"]
+
+
 def test_metrics_endpoint_content_type(server):
     handle, _ = server
     host, port = handle.server_address[:2]

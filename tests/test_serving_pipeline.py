@@ -314,3 +314,73 @@ def test_stage_timings_reach_metrics(artifacts):
         svc.metrics.observe_timer(svc.pipeline.timer)
         text = svc.metrics.render()
         assert 'albedo_stage_seconds{stage="stage1_candidates"}' in text
+
+
+def test_client_deadline_sheds_two_stage_before_compute(artifacts):
+    """Admission control must bite in pipeline mode too (regression: the
+    deadline was silently dropped on every path except pure batched ALS):
+    an already-lapsed deadline is shed with the 429-shaped DeadlineExceeded
+    before any stage spends work."""
+    from albedo_tpu.serving.batcher import DeadlineExceeded
+
+    ranker = StubRanker()
+    with _service(artifacts, ranker=ranker) as svc:
+        _, matrix, _, _ = artifacts
+        with pytest.raises(DeadlineExceeded):
+            svc.handle_recommend(
+                int(matrix.user_ids[0]), k=5,
+                deadline=time.monotonic() - 0.01,
+            )
+        assert ranker.calls == 0  # shed before compute, not computed-then-late
+        assert svc.metrics.deadline_shed.value() == 1
+
+
+def test_client_deadline_caps_ranker_budget(artifacts):
+    """A live-but-tight client deadline bounds the whole response: the
+    ranker's generous stage budget is cut to the client's remaining time,
+    so the request degrades to stage-1 scores inside the deadline instead
+    of arriving late."""
+    slow = StubRanker(delay_s=3.0)
+    with _service(
+        artifacts, ranker=slow,
+        deadlines=StageDeadlines(candidates_s=10.0, ranker_s=8.0),
+    ) as svc:
+        _, matrix, _, _ = artifacts
+        t0 = time.monotonic()
+        status, body = svc.handle_recommend(
+            int(matrix.user_ids[1]), k=5, deadline=t0 + 0.4,
+        )
+        assert status == 200
+        assert time.monotonic() - t0 < 2.5  # client budget, not ranker_s=8
+        assert "ranker_timeout" in body["degraded"]
+        assert body["items"]
+
+
+def test_client_deadline_timeout_does_not_penalize_breaker(artifacts):
+    """A source cut short by the CLIENT's deadline (its own stage budget
+    untouched) degrades but records no breaker outcome — a run of
+    tight-deadline requests must not trip a perfectly healthy source."""
+
+    class Slow(Recommender):
+        source = "content"
+
+        def recommend_for_users(self, user_ids):
+            time.sleep(1.0)
+            return pd.DataFrame()
+
+    tables, matrix, als, pop = artifacts
+    with RecommendationService(
+        als, matrix,
+        recommenders={"popularity": pop, "content": Slow()},
+        deadlines=StageDeadlines(candidates_s=30.0, ranker_s=0.5),
+    ) as svc:
+        for _ in range(3):
+            status, body = svc.handle_recommend(
+                int(matrix.user_ids[0]), k=5,
+                deadline=time.monotonic() + 0.15,
+            )
+            assert status == 200
+            assert "candidate_timeout_content" in body["degraded"]
+        br = svc.pipeline.breakers["content"]
+        assert br.state == "closed"
+        assert br.snapshot()["consecutive_failures"] == 0

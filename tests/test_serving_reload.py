@@ -1,0 +1,461 @@
+"""Validated zero-downtime hot-swap: gates, promote, rollback, watch, and the
+corrupt-artifact-mid-serve chaos drill through real HTTP."""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from albedo_tpu.datasets import synthetic_tables  # noqa: E402
+from albedo_tpu.datasets.artifacts import (  # noqa: E402
+    artifact_path,
+    save_pickle,
+    write_manifest,
+)
+from albedo_tpu.datasets.tables import popular_repos  # noqa: E402
+from albedo_tpu.models.als import ALSModel, ImplicitALS  # noqa: E402
+from albedo_tpu.recommenders import PopularityRecommender  # noqa: E402
+from albedo_tpu.serving import (  # noqa: E402
+    HotSwapManager,
+    RecommendationService,
+    serve,
+)
+from albedo_tpu.utils import faults  # noqa: E402
+
+K = 8
+
+
+@pytest.fixture(scope="module")
+def artifacts():
+    tables = synthetic_tables(n_users=80, n_items=50, mean_stars=6, seed=21)
+    matrix = tables.star_matrix()
+    model_a = ImplicitALS(rank=8, max_iter=2, seed=0).fit(matrix)
+    model_b = ImplicitALS(rank=8, max_iter=4, seed=3).fit(matrix)
+    return tables, matrix, model_a, model_b
+
+
+def _write_model(name: str, model: ALSModel, manifest: bool = True):
+    """Materialize a model artifact the way run_pipeline's store does."""
+    path = artifact_path(name)
+    save_pickle(path, model.to_arrays())
+    if manifest:
+        write_manifest(path)
+    return path
+
+
+def _service(artifacts, **kw):
+    tables, matrix, model_a, _ = artifacts
+    kw.setdefault("batch_window_ms", 0.0)
+    return RecommendationService(
+        model_a, matrix, repo_info=tables.repo_info, **kw
+    )
+
+
+def _expected(model: ALSModel, matrix, uid: int, k: int):
+    dense = matrix.users_of(np.array([uid], dtype=np.int64))
+    vals, idx = model.recommend(dense, k=k)
+    ok = (idx[0] >= 0) & np.isfinite(vals[0])
+    return [
+        (int(matrix.item_ids[i]), float(v))
+        for i, v in zip(idx[0][ok], vals[0][ok])
+    ]
+
+
+def test_promote_good_artifact_swaps_generation(artifacts):
+    tables, matrix, model_a, model_b = artifacts
+    with _service(artifacts) as svc:
+        mgr = HotSwapManager(svc, probe_users=4, probe_k=K)
+        path = _write_model("candidate-alsModel.pkl", model_b)
+        assert svc.generation.number == 1
+
+        report = mgr.request_reload(path)
+        assert report["outcome"] == "promoted", report
+        assert report["generation"] == 2
+        assert report["gates"]["manifest"] == "ok"
+        assert report["gates"]["invariants"] == "ok"
+        assert report["gates"]["post_swap_parity"] == "ok"
+        assert svc.generation.number == 2
+        assert svc.metrics.reloads.value(outcome="promoted") == 1
+        assert svc.metrics.model_generation.value() == 2
+
+        # Requests now serve model B's numbers, tagged generation 2.
+        uid = int(matrix.user_ids[0])
+        status, body = svc.handle_recommend(uid, k=K, exclude_seen=False)
+        assert status == 200 and body["generation"] == 2
+        got = [(i["repo_id"], i["score"]) for i in body["items"]]
+        assert got == _expected(model_b, matrix, uid, K)
+
+        # The displaced generation's batcher was retired — no zombies.
+        assert svc._zombie_batchers == []
+
+        ready, rep = svc.readiness()
+        assert ready and rep["generation"] == 2 and rep["origin"].endswith(".pkl")
+
+
+def test_corrupt_candidate_rejected_and_quarantined(artifacts):
+    _, matrix, _, model_b = artifacts
+    with _service(artifacts) as svc:
+        mgr = HotSwapManager(svc, probe_users=4, probe_k=K)
+        path = _write_model("candidate-alsModel.pkl", model_b)
+        # Flip one byte AFTER the manifest was written: checksum mismatch.
+        data = bytearray(path.read_bytes())
+        data[len(data) // 2] ^= 0xFF
+        path.write_bytes(bytes(data))
+
+        report = mgr.request_reload(path)
+        assert report["outcome"] == "rejected" and report["gate"] == "manifest"
+        assert "quarantined_to" in report
+        assert not path.exists()  # moved aside as evidence
+        assert path.with_name(report["quarantined_to"]).exists()
+        # Incumbent untouched and still serving.
+        assert svc.generation.number == 1
+        status, body = svc.handle_recommend(int(matrix.user_ids[0]), k=K)
+        assert status == 200 and body["generation"] == 1
+        assert svc.metrics.reload_rejected.value(gate="manifest") == 1
+        assert svc.metrics.reloads.value(outcome="rejected") == 1
+
+
+def test_invariant_gate_rejects_wrong_shapes_and_nonfinite(artifacts):
+    _, matrix, _, model_b = artifacts
+    with _service(artifacts) as svc:
+        mgr = HotSwapManager(svc, probe_users=4, probe_k=K)
+        # Wrong user count: a different dataset's model must not swap in.
+        wrong = ALSModel(
+            np.ones((matrix.n_users + 5, 8), np.float32),
+            np.ones((matrix.n_items, 8), np.float32), 8,
+        )
+        report = mgr.request_reload(_write_model("wrong-alsModel.pkl", wrong))
+        assert report["outcome"] == "rejected" and report["gate"] == "invariants"
+        assert "matrix" in report["detail"]
+
+        # NaN factors: loadable, checksum-clean, and still not servable.
+        uf = model_b.user_factors.copy()
+        uf[3, 2] = np.nan
+        bad = ALSModel(uf, model_b.item_factors.copy(), model_b.rank)
+        report = mgr.request_reload(_write_model("nan-alsModel.pkl", bad))
+        assert report["outcome"] == "rejected" and report["gate"] == "invariants"
+        assert "finite" in report["detail"]
+        assert svc.generation.number == 1
+
+
+def test_missing_manifest_is_recorded_not_fatal(artifacts):
+    _, _, _, model_b = artifacts
+    with _service(artifacts) as svc:
+        mgr = HotSwapManager(svc, probe_users=4, probe_k=K)
+        path = _write_model("bare-alsModel.pkl", model_b, manifest=False)
+        report = mgr.request_reload(path)
+        assert report["outcome"] == "promoted"
+        assert "unverified" in report["gates"]["manifest"]
+
+
+def test_rollback_on_post_swap_parity_failure(artifacts):
+    _, matrix, _, model_b = artifacts
+    with _service(artifacts) as svc:
+        mgr = HotSwapManager(svc, probe_users=4, probe_k=K)
+        mgr._post_swap_parity = lambda *a, **k: (False, "forced mismatch")
+        path = _write_model("parity-alsModel.pkl", model_b)
+
+        report = mgr.request_reload(path)
+        assert report["outcome"] == "rolled_back"
+        assert svc.generation.number == 1  # incumbent re-promoted
+        assert svc.metrics.reloads.value(outcome="rolled_back") == 1
+        assert not path.exists()  # bad artifact quarantined
+        # The incumbent still answers (its batcher was never stopped).
+        status, body = svc.handle_recommend(int(matrix.user_ids[1]), k=K)
+        assert status == 200 and body["generation"] == 1
+        assert svc._zombie_batchers == []
+
+
+def test_transient_overload_during_parity_probe_keeps_promotion(artifacts):
+    """A full queue / busy worker during the post-swap probe is NOT a parity
+    verdict: the promotion stands (gates already validated the model
+    directly) and the artifact is NOT quarantined — a loaded fleet must not
+    destroy every fresh artifact by rename."""
+    from albedo_tpu.serving import QueueOverflow
+
+    _, _, _, model_b = artifacts
+    with _service(artifacts) as svc:
+        mgr = HotSwapManager(svc, probe_users=4, probe_k=K)
+
+        def overloaded(*a, **kw):
+            raise QueueOverflow("serving queue full (256 waiting)")
+
+        mgr._probe_via_batcher = overloaded
+        path = _write_model("busy-alsModel.pkl", model_b)
+        report = mgr.request_reload(path)
+        assert report["outcome"] == "promoted"
+        assert "inconclusive" in report["gates"]["post_swap_parity"]
+        assert svc.generation.number == 2
+        assert path.exists()  # not quarantined
+
+
+def test_reload_rejects_traversal_names(artifacts):
+    with _service(artifacts) as svc:
+        mgr = HotSwapManager(svc, probe_users=4, probe_k=K)
+        report = mgr.request_reload("../../etc/passwd")
+        assert report["outcome"] == "rejected"
+        assert "escapes the store" in report["detail"]
+        assert svc.generation.number == 1
+
+
+def test_generation_numbers_never_reused_after_rollback(artifacts):
+    """Candidate numbers come from a monotonic counter, not the current
+    generation + 1 (regression): after a rollback 2 -> 1, the next promotion
+    must be 3 — a slow request still holding the first gen-2 snapshot could
+    otherwise write its model's body under the second gen-2's cache key."""
+    _, _, _, model_b = artifacts
+    with _service(artifacts) as svc:
+        mgr = HotSwapManager(svc, probe_users=4, probe_k=K)
+        mgr._post_swap_parity = lambda *a, **k: (False, "forced mismatch")
+        report = mgr.request_reload(_write_model("re1-alsModel.pkl", model_b))
+        assert report["outcome"] == "rolled_back" and svc.generation.number == 1
+
+        mgr._post_swap_parity = lambda *a, **k: (True, "ok")
+        report = mgr.request_reload(_write_model("re2-alsModel.pkl", model_b))
+        assert report["outcome"] == "promoted"
+        assert report["generation"] == 3  # "2" already served traffic once
+        assert svc.generation.number == 3
+
+
+def test_watcher_falls_back_to_older_candidate_when_newest_rejected(artifacts):
+    """Two candidates land between polls and the newest fails its gates: the
+    SAME sweep must attempt the older valid one (regression: it was marked
+    seen and silently dropped forever, pinning the service to a stale
+    model while a validated artifact sat in the store)."""
+    _, _, _, model_b = artifacts
+    with _service(artifacts) as svc:
+        # Tiny interval: _watch_once's post-promotion watchdog pause must
+        # not stall the test for the production default.
+        mgr = HotSwapManager(svc, probe_users=4, probe_k=K,
+                             watch_interval_s=0.05)
+        good = _write_model("w1-alsModel.pkl", model_b)
+        bad = _write_model("w2-alsModel.pkl", model_b)
+        data = bytearray(bad.read_bytes())
+        data[len(data) // 2] ^= 0xFF
+        bad.write_bytes(bytes(data))  # newest: checksum mismatch
+
+        mgr._watch_once()
+        assert svc.generation.number == 2
+        assert svc.generation.origin == str(good)
+        assert svc.metrics.reload_rejected.value(gate="manifest") == 1
+        # Both outcomes marked seen: the next sweep attempts nothing new.
+        before = svc.metrics.reloads.total()
+        mgr._watch_once()
+        assert svc.metrics.reloads.total() == before
+
+
+def test_error_rate_watchdog_rolls_back(artifacts):
+    _, matrix, _, model_b = artifacts
+    with _service(artifacts) as svc:
+        mgr = HotSwapManager(
+            svc, probe_users=4, probe_k=K,
+            error_rate_threshold=0.5, error_rate_min_requests=10,
+        )
+        report = mgr.request_reload(_write_model("err-alsModel.pkl", model_b))
+        assert report["outcome"] == "promoted" and svc.generation.number == 2
+
+        # Simulate a post-swap 5xx storm on the request counter.
+        for _ in range(12):
+            svc.metrics.requests.inc(route="recommend", status="500")
+        verdict = mgr.check_error_rate()
+        assert verdict["verdict"] == "regressed"
+        assert verdict["rolled_back_to"] == 1
+        assert svc.generation.number == 1
+        assert svc.metrics.reloads.value(outcome="rolled_back") == 1
+        # And the engine still serves on the rolled-back generation.
+        status, body = svc.handle_recommend(int(matrix.user_ids[2]), k=K)
+        assert status == 200 and body["generation"] == 1
+
+
+def test_parity_rollback_clears_watchdog_state(artifacts):
+    """A parity-failure rollback must clear the error-rate watchdog's
+    baseline (regression): a later 5xx spike unrelated to any swap would
+    otherwise 'roll back' the restored incumbent onto itself and
+    quarantine-rename the healthy artifact behind the live model."""
+    _, matrix, _, model_b = artifacts
+    with _service(artifacts) as svc:
+        mgr = HotSwapManager(svc, probe_users=4, probe_k=K,
+                             error_rate_min_requests=10)
+        mgr._post_swap_parity = lambda *a, **k: (False, "forced mismatch")
+        report = mgr.request_reload(_write_model("stale-alsModel.pkl", model_b))
+        assert report["outcome"] == "rolled_back"
+        assert svc.generation.number == 1
+
+        for _ in range(12):
+            svc.metrics.requests.inc(route="recommend", status="500")
+        verdict = mgr.check_error_rate()
+        assert verdict == {"checked": False}
+        assert svc.generation.number == 1
+        # Only the parity rollback counted; the 5xx spike triggered nothing.
+        assert svc.metrics.reloads.value(outcome="rolled_back") == 1
+
+
+def test_error_rate_watchdog_healthy_traffic_keeps_generation(artifacts):
+    _, _, _, model_b = artifacts
+    with _service(artifacts) as svc:
+        mgr = HotSwapManager(svc, probe_users=4, probe_k=K,
+                             error_rate_min_requests=5)
+        mgr.request_reload(_write_model("ok-alsModel.pkl", model_b))
+        for _ in range(20):
+            svc.metrics.requests.inc(route="recommend", status="200")
+        verdict = mgr.check_error_rate()
+        assert verdict["verdict"] == "healthy"
+        assert svc.generation.number == 2
+
+
+def test_swap_under_load_parity(artifacts):
+    """Concurrent /recommend traffic across a hot-swap sees only generation
+    1 or 2 responses, each bit-exact for its generation's model — no torn
+    reads, no mixed state."""
+    tables, matrix, model_a, model_b = artifacts
+    uids = [int(u) for u in matrix.user_ids[:6]]
+    expected = {
+        1: {uid: _expected(model_a, matrix, uid, K) for uid in uids},
+        2: {uid: _expected(model_b, matrix, uid, K) for uid in uids},
+    }
+    with _service(artifacts, cache_ttl=0.0) as svc:
+        mgr = HotSwapManager(svc, probe_users=4, probe_k=K)
+        path = _write_model("load-alsModel.pkl", model_b)
+
+        stop = threading.Event()
+        results: list[tuple[int, int, list]] = []
+        errors: list[BaseException] = []
+
+        def hammer():
+            i = 0
+            while not stop.is_set():
+                uid = uids[i % len(uids)]
+                i += 1
+                try:
+                    status, body = svc.handle_recommend(
+                        uid, k=K, exclude_seen=False
+                    )
+                    assert status == 200, body
+                    results.append((
+                        body["generation"], uid,
+                        [(it["repo_id"], it["score"]) for it in body["items"]],
+                    ))
+                except BaseException as e:  # noqa: BLE001
+                    errors.append(e)
+                    return
+
+        threads = [threading.Thread(target=hammer) for _ in range(4)]
+        for t in threads:
+            t.start()
+        time.sleep(0.2)  # traffic flowing on generation 1
+        report = mgr.request_reload(path)  # swap under load
+        time.sleep(0.2)  # traffic flowing on generation 2
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+
+        assert not errors, errors[0]
+        assert report["outcome"] == "promoted"
+        gens = {g for g, _, _ in results}
+        assert gens == {1, 2}, f"expected traffic on both generations, saw {gens}"
+        for gen, uid, items in results:
+            assert items == expected[gen][uid], (
+                f"generation {gen} response for user {uid} does not match "
+                f"that generation's model"
+            )
+
+
+def test_watcher_promotes_fresh_artifact(artifacts):
+    _, _, _, model_b = artifacts
+    with _service(artifacts) as svc:
+        mgr = HotSwapManager(svc, artifact_glob="watched-*.pkl",
+                             watch_interval_s=0.05, probe_users=4, probe_k=K)
+        mgr.start_watch()
+        try:
+            assert svc.generation.number == 1
+            _write_model("watched-alsModel.pkl", model_b)
+            deadline = time.monotonic() + 20
+            while svc.generation.number != 2 and time.monotonic() < deadline:
+                time.sleep(0.02)
+            assert svc.generation.number == 2
+            assert svc.generation.origin.endswith("watched-alsModel.pkl")
+        finally:
+            mgr.stop()
+
+
+# --- the acceptance chaos drill, through real HTTP ---------------------------
+
+
+def _get(handle, path):
+    host, port = handle.server_address[:2]
+    try:
+        with urllib.request.urlopen(f"http://{host}:{port}{path}", timeout=30) as r:
+            return r.status, json.loads(r.read() or b"{}")
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}")
+
+
+def _post(handle, path):
+    host, port = handle.server_address[:2]
+    req = urllib.request.Request(
+        f"http://{host}:{port}{path}", data=b"", method="POST"
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=60) as r:
+            return r.status, json.loads(r.read() or b"{}")
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}")
+
+
+@pytest.mark.chaos
+def test_corrupt_candidate_mid_serve_drill_over_http(artifacts):
+    """Acceptance: inject a corrupt candidate via the fault harness during a
+    reload — the incumbent keeps serving, the corrupt generation is
+    quarantined and counted on /metrics, a subsequent good artifact
+    promotes, and probe parity holds across the swap."""
+    tables, matrix, model_a, model_b = artifacts
+    pop = PopularityRecommender(popular_repos(tables.repo_info, 1, 10**9), top_k=20)
+    with _service(artifacts, recommenders={"popularity": pop}) as svc:
+        mgr = HotSwapManager(svc, probe_users=4, probe_k=K)
+        with serve(svc, port=0) as handle:
+            uid = int(matrix.user_ids[0])
+            status, before = _get(handle, f"/recommend/{uid}?k={K}&exclude_seen=0")
+            assert status == 200 and before["generation"] == 1
+
+            # Candidate lands; the fault harness corrupts it as the reload
+            # touches it (reload.load fires before the manifest check).
+            path = _write_model("drill-alsModel.pkl", model_b)
+            faults.arm("reload.load", kind="corrupt", at=1)
+            status, report = _post(handle, "/admin/reload?artifact=" + path.name)
+            assert status == 409
+            assert report["outcome"] == "rejected" and report["gate"] == "manifest"
+
+            # Incumbent survived, same generation, same answers.
+            status, after = _get(handle, f"/recommend/{uid}?k={K}&exclude_seen=0")
+            assert status == 200 and after["generation"] == 1
+            assert after["items"] == before["items"]
+
+            # The quarantine, the rejection, and the fault firing are all
+            # visible on /metrics.
+            host, port = handle.server_address[:2]
+            with urllib.request.urlopen(f"http://{host}:{port}/metrics", timeout=30) as r:
+                text = r.read().decode()
+            assert 'albedo_reload_rejected_total{gate="manifest"} 1' in text
+            assert 'albedo_faults_fired_total{site="reload.load"} 1' in text
+            assert 'albedo_artifact_corruptions_total{artifact="drill-alsModel.pkl"} 1' in text
+            assert "albedo_model_generation 1" in text
+
+            # A subsequent good artifact promotes...
+            good = _write_model("drill2-alsModel.pkl", model_b)
+            status, report = _post(handle, "/admin/reload?artifact=" + good.name)
+            assert status == 200 and report["outcome"] == "promoted", report
+
+            # ...and probe parity holds across the swap: the served top-K
+            # for the probe user now matches model B bit-for-bit.
+            status, swapped = _get(handle, f"/recommend/{uid}?k={K}&exclude_seen=0")
+            assert status == 200 and swapped["generation"] == 2
+            got = [(i["repo_id"], i["score"]) for i in swapped["items"]]
+            assert got == _expected(model_b, matrix, uid, K)
+            status, ready = _get(handle, "/healthz/ready")
+            assert status == 200 and ready["generation"] == 2
