@@ -8,9 +8,10 @@ ARGS ?=
 JOBS = popularity curation content train_als cv_als build_user_profile \
        build_repo_profile train_word2vec train_lr cv_lr item_cf user_cf \
        tfidf_content ranking_mf collect_data drop_data sync_index serve play \
-       run_pipeline
+       run_pipeline datacheck
 
-.PHONY: $(JOBS) test test-all bench serve-bench chaos chaos-serve dryrun
+.PHONY: $(JOBS) test test-all bench serve-bench datacheck-bench chaos \
+        chaos-serve dryrun
 
 $(JOBS):
 	$(PY) -m albedo_tpu.cli $@ $(ARGS)
@@ -30,6 +31,11 @@ bench:
 # DURATION/TRIALS/K).
 serve-bench:
 	$(PY) bench.py serving
+
+# Ingest-validation overhead scenario: firewall off vs repair over the same
+# tables, interleaved trials, median overhead fraction (<5% budget).
+datacheck-bench:
+	$(PY) bench.py datacheck
 
 # Fault-injection drills: the full chaos matrix (corrupt-artifact healing,
 # kill/SIGTERM-resume parity through the real CLI, fault-injected serving

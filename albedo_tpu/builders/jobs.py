@@ -105,10 +105,33 @@ class JobContext:
         star = self.tables().starring
         return tuple(star["user_id"].value_counts().index[:5].tolist())
 
+    def data_policy(self) -> str:
+        """The ingest firewall policy (``--data-policy strict|repair|off``;
+        default ``repair``): strict fails the job on any bad star row,
+        repair drops/quarantines bad rows, off is the bare seed path."""
+        from albedo_tpu.datasets.validate import default_policy
+
+        return getattr(self.args, "data_policy", None) or default_policy()
+
     def matrix(self):
         if "matrix" not in self._cache:
-            self._cache["matrix"] = self.tables().star_matrix()
+            policy = self.data_policy()
+            matrix, report = self.tables().validated_star_matrix(
+                policy=policy,
+                quarantine_name=(
+                    self.artifact_name("starring") if policy == "repair" else None
+                ),
+                now=self.now,
+            )
+            self._cache["matrix"] = matrix
+            self._cache["data_report"] = report
         return self._cache["matrix"]
+
+    def data_report(self):
+        """The ingest :class:`~albedo_tpu.datasets.validate.ValidationReport`
+        (building the matrix on first call)."""
+        self.matrix()
+        return self._cache["data_report"]
 
     def als_solver(self) -> tuple[str, int]:
         """(solver, cg_steps) from the CLI ``--solver``/``--cg-steps`` flags."""
@@ -143,6 +166,8 @@ class JobContext:
             checkpointed_als_fit,
         )
 
+        from albedo_tpu.utils.watchdog import DivergenceWatchdog
+
         every, resume, keep_last = self.checkpoint_opts()
         ckdir = get_settings().checkpoint_dir / self.artifact_name(key)
         if not resume and key not in self._ckpt_initialized and ckdir.exists():
@@ -153,11 +178,18 @@ class JobContext:
             # of deleting them and restarting from iteration 0.
             shutil.rmtree(ckdir)
         self._ckpt_initialized.add(key)
-        with PreemptionHandler() as preemption:
-            return checkpointed_als_fit(
-                est, matrix, ckdir, every=every, keep_last=keep_last,
-                preemption=preemption,
-            )
+        watchdog = DivergenceWatchdog()
+        try:
+            with PreemptionHandler() as preemption:
+                return checkpointed_als_fit(
+                    est, matrix, ckdir, every=every, keep_last=keep_last,
+                    preemption=preemption, watchdog=watchdog,
+                )
+        finally:
+            # Trips (with remediation outcomes) feed the publish stamp's
+            # quality record, even when the fit ultimately diverged.
+            if watchdog.trips:
+                self._cache.setdefault("watchdog_trips", []).extend(watchdog.trips)
 
     def star_range(self) -> tuple[int, int]:
         # The reference's popular/profile star windows assume GitHub-scale
@@ -166,15 +198,28 @@ class JobContext:
             return (1000, 290_000)
         return (1, 10**9)
 
-    def als_model(self, rank=50, reg=0.5, alpha=40.0, iters=26):
-        from albedo_tpu.models.als import ImplicitALS
-
+    def als_key(self, rank=50, reg=0.5, alpha=40.0, iters=26) -> str:
+        """The flagship ALS artifact's base key (hyperparams baked into the
+        name, solver-tagged when not the parity default) — one definition
+        shared by training, the canary publish gate, and the serve watcher."""
         if self.small:
             rank, iters = 16, 8
         solver, cg_steps = self.als_solver()
         key = f"alsModel-{rank}-{reg}-{alpha}-{iters}"
         if solver != "cholesky":
             key += f"-{solver}{cg_steps}"  # solver-tagged artifact, no mixups
+        return key
+
+    def als_artifact_name(self, **kw) -> str:
+        return self.artifact_name(self.als_key(**kw) + ".pkl")
+
+    def als_model(self, rank=50, reg=0.5, alpha=40.0, iters=26):
+        from albedo_tpu.models.als import ImplicitALS
+
+        key = self.als_key(rank=rank, reg=reg, alpha=alpha, iters=iters)
+        if self.small:
+            rank, iters = 16, 8
+        solver, cg_steps = self.als_solver()
 
         def train():
             est = ImplicitALS(
@@ -184,7 +229,14 @@ class JobContext:
             every, _, _ = self.checkpoint_opts()
             if every > 0:
                 return self.checkpointed_als(est, self.matrix(), key)
-            return est.fit(self.matrix())
+            # Non-checkpointed fits still run under the divergence watchdog
+            # (check-final + one damped re-fit; utils.watchdog.guarded_fit).
+            from albedo_tpu.utils.watchdog import guarded_fit
+
+            model, trips = guarded_fit(est, self.matrix())
+            if trips:
+                self._cache.setdefault("watchdog_trips", []).extend(trips)
+            return model
 
         if "als" not in self._cache:
             from albedo_tpu.models.als import ALSModel
@@ -672,6 +724,7 @@ def serve_job(args) -> None:
     extra.add_argument("--window-ms", type=float, default=2.0)
     extra.add_argument("--reload-watch", action="store_true")
     extra.add_argument("--reload-interval", type=float, default=10.0)
+    extra.add_argument("--reload-require-stamp", action="store_true")
     ns, _ = extra.parse_known_args(getattr(args, "_rest", []))
 
     ctx = JobContext(args)
@@ -706,6 +759,7 @@ def serve_job(args) -> None:
         service,
         artifact_glob=f"{ctx.tag}-alsModel-*.pkl",
         watch_interval_s=ns.reload_interval,
+        require_stamp=ns.reload_require_stamp,
     )
     if ns.reload_watch:
         manager.start_watch()
@@ -821,6 +875,37 @@ def sync_index_job(args) -> None:
         artifact_name=ctx.artifact_name("contentIndex-v2.npz"),
     )
     _report("sync_index", "indexed_repos", float(len(backend.item_ids)), t0)
+
+
+@register_job("datacheck")
+def datacheck_job(args) -> int | None:
+    """Standalone run of the ingest data-quality firewall (``make datacheck``):
+    evaluates every rule in ``datasets.validate`` against the configured
+    dataset (``--tables`` or synthetic), prints per-rule counts, mutates and
+    quarantines NOTHING, and exits 1 when violations exist so CI can gate on
+    dataset health before a training run spends accelerator time."""
+    from albedo_tpu.datasets.validate import validate_starring
+
+    t0 = time.time()
+    ctx = JobContext(args)
+    tables = ctx.tables()
+    s = tables.starring.sort_values("starred_at", kind="stable")
+    _, report = validate_starring(
+        s,
+        user_vocab=tables.user_info["user_id"].to_numpy(np.int64)
+        if len(tables.user_info) else None,
+        repo_vocab=tables.repo_info["repo_id"].to_numpy(np.int64)
+        if len(tables.repo_info) else None,
+        now=ctx.now,
+        policy="repair",  # evaluate + count every rule; report-only, no sidecar
+        quarantine_name=None,
+    )
+    for rule, count in sorted(report.violations.items()):
+        print(f"[datacheck] {rule}: {count}")
+    print(f"[datacheck] rows = {report.rows_in} -> {report.rows_out} "
+          f"(policy would drop {report.total})")
+    _report("datacheck", "violations", float(report.total), t0)
+    return 1 if report.total else None
 
 
 @register_job("cv_lr")

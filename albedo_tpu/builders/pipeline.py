@@ -1,8 +1,9 @@
 """The full offline chain from one command, with a journal and resume.
 
-``albedo-tpu run_pipeline`` drives the paper's batch-job DAG — popularity ->
-ALS -> user/repo profiles -> word2vec -> LR ranker — the way the reference's
-Makefile drives its spark-submit targets one by one, but fault-tolerantly:
+``albedo-tpu run_pipeline`` drives the paper's batch-job DAG — validated
+ingest -> popularity -> ALS -> user/repo profiles -> word2vec -> LR ranker
+-> canary publish gate — the way the reference's Makefile drives its
+spark-submit targets one by one, but fault-tolerantly:
 
 - every stage is recorded in a per-run JSON **journal**
   (``<tag>-pipeline-journal.json`` in the artifact dir): status
@@ -39,8 +40,15 @@ from albedo_tpu.utils.jsonio import atomic_write_json, read_json_or_none
 from albedo_tpu.utils.retry import RetryPolicy, retry_call
 
 _STAGE_FAULT = faults.site("pipeline.stage")
+# The publish quality gate's own site: fires inside the canary evaluation so
+# chaos drills can fail the GATE (not just the stage wrapper) deterministically.
+_CANARY_FAULT = faults.site("pipeline.canary")
 
 JOURNAL_NAME = "pipeline-journal.json"
+
+# Canary gate defaults: a candidate must score at least this fraction of the
+# last-known-good artifact's recorded canary score to publish.
+CANARY_TOLERANCE = 0.10
 
 
 class PipelineStageFailed(RuntimeError):
@@ -52,10 +60,40 @@ class PipelineStageFailed(RuntimeError):
         self.cause = cause
 
 
+class PublishRejected(RuntimeError):
+    """The canary quality gate refused to publish the trained artifact.
+
+    Deliberately NOT a stage *failure*: the chain ran to completion and the
+    journal says so — the artifact just isn't good enough to stamp. The CLI
+    maps this to exit code 4 (distinct from 1 = crash and 75 = preempted)
+    so schedulers can tell "retrain/investigate" from "rerun".
+    """
+
+    def __init__(self, detail: str, score: float | None = None,
+                 baseline: float | None = None):
+        super().__init__(detail)
+        self.detail = detail
+        self.score = score
+        self.baseline = baseline
+
+
 # --- stages -------------------------------------------------------------------
 # Each stage: fn(ctx) -> (result_dict, artifact_names). Stages lean on the
 # artifact store / JobContext memoization, so a resumed or repeated stage is
 # a cheap load, and a regenerated (quarantined) artifact is rebuilt here.
+
+
+def _stage_ingest(ctx) -> tuple[dict, list[str]]:
+    """The data-quality firewall pass: build the validated star matrix
+    (``datasets.validate`` runs inside ``validated_star_matrix`` under the
+    job's ``--data-policy``), journal the per-rule violation counts and the
+    quarantine sidecar name. Under ``strict`` a dirty dataset fails the
+    pipeline HERE, before any accelerator time is spent."""
+    ctx.matrix()
+    report = ctx.data_report()
+    result = report.to_dict()
+    artifacts = [report.quarantined_to] if report.quarantined_to else []
+    return result, artifacts
 
 
 def _stage_popularity(ctx) -> tuple[dict, list[str]]:
@@ -100,13 +138,127 @@ def _stage_train_lr(ctx) -> tuple[dict, list[str]]:
     return {"auc": float(auc) if auc is not None else None}, []
 
 
+def _canary_score(ctx) -> float:
+    """NDCG@30 of the trained ALS artifact on the held-out probe slice (the
+    deterministic test-user sample + canary user every builder evaluates)."""
+    from albedo_tpu.recommenders import ALSRecommender
+
+    model = ctx.als_model()
+    matrix = ctx.matrix()
+    users = matrix.user_ids[ctx.test_user_dense(150)]
+    frame = ALSRecommender(model, matrix, top_k=30).recommend_for_users(users)
+    return float(ctx.evaluate_topk(frame))
+
+
+def last_known_good(ctx) -> tuple[str, float] | None:
+    """(artifact name, canary score) of the newest stamped flagship artifact
+    for this dataset tag AND hyperparameter key, or None when nothing was
+    ever published. Keying on ``als_artifact_name`` (rank/reg/alpha/iters/
+    solver baked in) keeps the gate honest: a ``--small`` rank-16 run must
+    not be judged against a rank-50 stamp's score — different configs have
+    different legitimate baselines."""
+    from albedo_tpu.datasets import artifacts as store
+
+    art_dir = store.get_settings().artifact_dir
+    best: tuple[float, str, float] | None = None
+    for mpath in art_dir.glob(f"{ctx.als_artifact_name()}*{store.META_SUFFIX}"):
+        if ".corrupt-" in mpath.name:
+            continue
+        meta = store.read_meta(art_dir / mpath.name[: -len(store.META_SUFFIX)])
+        if not meta:
+            continue
+        score = (meta.get("canary") or {}).get("score")
+        if score is None:
+            continue
+        stamped = float(meta.get("stamped_at", 0.0))
+        if best is None or stamped > best[0]:
+            best = (stamped, str(meta.get("artifact", mpath.name)), float(score))
+    return None if best is None else (best[1], best[2])
+
+
+def _stage_canary(ctx) -> tuple[dict, list[str]]:
+    """The publish quality gate: score the trained artifact on the probe
+    slice, compare against the last-known-good stamp (and an optional
+    absolute floor), and only then stamp the artifact with its lineage +
+    quality record (``.meta.json``) — the serving reload's stamp gate
+    refuses anything unstamped or regressed, so a bad model can finish
+    training yet never reach the swap path.
+
+    ``--publish-force`` publishes past a failed gate, loudly: the journal
+    and the stamp both carry ``forced: true``.
+    """
+    from albedo_tpu.datasets import artifacts as store
+    from albedo_tpu.datasets.validate import matrix_fingerprint
+    from albedo_tpu.utils import events
+
+    score = _canary_score(ctx)
+    _CANARY_FAULT.hit()
+    floor = float(getattr(ctx.args, "canary_floor", 0.0) or 0.0)
+    tolerance = getattr(ctx.args, "canary_tolerance", None)
+    tolerance = CANARY_TOLERANCE if tolerance is None else float(tolerance)
+    force = bool(getattr(ctx.args, "publish_force", False))
+
+    lkg = last_known_good(ctx)
+    baseline = None if lkg is None else lkg[1]
+    failures = []
+    if score < floor:
+        failures.append(f"score {score:.5f} below --canary-floor {floor:.5f}")
+    if baseline is not None and score < baseline * (1.0 - tolerance):
+        failures.append(
+            f"score {score:.5f} regressed more than {tolerance:.0%} below "
+            f"last-known-good {baseline:.5f} ({lkg[0]})"
+        )
+    passed = not failures
+    result = {
+        "metric": "ndcg@30",
+        "score": round(score, 6),
+        "baseline": None if baseline is None else round(baseline, 6),
+        "passed": passed,
+        "forced": bool(force and not passed),
+    }
+    if not passed:
+        if not force:
+            # Counted only on an actual refusal — a forced publish DID
+            # publish (the override stays visible via forced: true in the
+            # stamp/journal), and the reload stamp gate counts the same way.
+            events.publish_rejected.inc(gate="canary")
+            raise PublishRejected("; ".join(failures), score=score, baseline=baseline)
+        # Loud by design: a forced publish must be unmissable in the logs
+        # and permanently recorded in both the journal and the stamp.
+        print(f"[run_pipeline] !!! CANARY GATE OVERRIDDEN (--publish-force): "
+              f"{'; '.join(failures)} — publishing anyway")
+
+    report = ctx.data_report()
+    path = store.artifact_path(ctx.als_artifact_name())
+    store.write_meta(path, {
+        "lineage": {
+            "data_hash": matrix_fingerprint(ctx.matrix()),
+            "rows": {
+                "in": report.rows_in, "out": report.rows_out,
+                "n_users": int(ctx.matrix().n_users),
+                "n_items": int(ctx.matrix().n_items),
+                "nnz": int(ctx.matrix().nnz),
+            },
+            "quarantined": report.violations,
+            "policy": report.policy,
+        },
+        "watchdog": {
+            "trips": list(ctx._cache.get("watchdog_trips", [])),
+        },
+        "canary": result,
+    })
+    return result, [store.meta_path(path).name]
+
+
 STAGES: tuple[tuple[str, Callable], ...] = (
+    ("ingest", _stage_ingest),
     ("popularity", _stage_popularity),
     ("train_als", _stage_train_als),
     ("user_profile", _stage_user_profile),
     ("repo_profile", _stage_repo_profile),
     ("word2vec", _stage_word2vec),
     ("train_lr", _stage_train_lr),
+    ("canary", _stage_canary),
 )
 
 
@@ -192,14 +344,24 @@ def run_pipeline(
                 sleeper=sleeper,
                 # A preemption notice is NOT a transient failure: retrying
                 # would restart training under a scheduler that is about to
-                # hard-kill us. Let it propagate for the CLI's exit-75 path.
-                retry_on=lambda e: not isinstance(e, Preempted),
+                # hard-kill us. A canary-gate refusal is a VERDICT — the
+                # same artifact would score the same again. Both propagate.
+                retry_on=lambda e: not isinstance(e, (Preempted, PublishRejected)),
             )
         except Preempted:
             record.update(status="preempted", finished_at=time.time())
             journal["status"] = "preempted"
             _save_journal(journal_path, journal)
             raise  # cli.main maps this to exit 75; --resume continues
+        except PublishRejected as e:
+            record.update(
+                status="rejected", finished_at=time.time(),
+                error=str(e),
+                result={"score": e.score, "baseline": e.baseline, "passed": False},
+            )
+            journal["status"] = "rejected"
+            _save_journal(journal_path, journal)
+            raise  # run_pipeline_job maps this to exit 4
         except Exception as e:  # noqa: BLE001 — journal the failure, then raise
             record.update(status="failed", error=repr(e), finished_at=time.time())
             journal["status"] = "failed"
@@ -235,16 +397,28 @@ def run_pipeline_job(args) -> int | None:
     """The one-command offline chain (see module docstring).
 
     Extra flags: --stages a,b,c (subset, in canonical order),
-    --max-stage-attempts N (default 3). Honors the global --resume,
+    --max-stage-attempts N (default 3), --canary-floor SCORE (absolute
+    NDCG@30 minimum for the publish gate), --canary-tolerance FRAC (max
+    allowed regression vs the last-known-good stamp, default 0.10),
+    --publish-force (publish past a failed canary gate, loudly journaled).
+    Honors the global --resume, --data-policy,
     --checkpoint-every/--keep-last (ALS mid-fit checkpoints), --small,
-    --tables.
+    --tables. Exit codes: 0 ok, 1 stage failure, 4 canary gate refused the
+    publish, 75 preempted.
     """
     from albedo_tpu.builders.jobs import JobContext
 
     extra = argparse.ArgumentParser()
     extra.add_argument("--stages", default="")
     extra.add_argument("--max-stage-attempts", type=int, default=3)
+    extra.add_argument("--canary-floor", type=float, default=0.0)
+    extra.add_argument("--canary-tolerance", type=float, default=None)
+    extra.add_argument("--publish-force", action="store_true")
     ns, _ = extra.parse_known_args(getattr(args, "_rest", []))
+    # The canary stage reads its knobs off the shared args namespace.
+    args.canary_floor = ns.canary_floor
+    args.canary_tolerance = ns.canary_tolerance
+    args.publish_force = ns.publish_force
 
     t0 = time.time()
     ctx = JobContext(args)
@@ -256,6 +430,10 @@ def run_pipeline_job(args) -> int | None:
             stages=stages,
             max_stage_attempts=ns.max_stage_attempts,
         )
+    except PublishRejected as e:
+        print(f"[run_pipeline] PUBLISH REFUSED by the canary gate: {e} "
+              f"(artifact trained but NOT stamped; --publish-force overrides)")
+        return 4
     except PipelineStageFailed as e:
         print(f"[run_pipeline] FAILED: {e} (journal has the record; rerun "
               f"with --resume to retry from there)")

@@ -37,6 +37,16 @@ def main(argv: list[str] | None = None) -> int:
         "--now", type=float, default=None, help="epoch seconds for date features"
     )
     parser.add_argument(
+        "--data-policy",
+        choices=("strict", "repair", "off"),
+        default=None,
+        help="ingest data-quality firewall (datasets/validate.py): strict = "
+        "any bad star row fails the job, repair (default) = drop bad rows "
+        "and quarantine them to a reviewable sidecar, off = trust the data "
+        "(the seed path). Violations are counted per rule in "
+        "albedo_data_violations_total on /metrics",
+    )
+    parser.add_argument(
         "--solver",
         choices=("cholesky", "cg"),
         default="cholesky",

@@ -30,7 +30,6 @@ healing story for offline reruns and live swaps.
 from __future__ import annotations
 
 import hashlib
-import itertools
 import json
 import logging
 import shutil
@@ -42,13 +41,15 @@ import numpy as np
 
 from albedo_tpu.settings import get_settings
 from albedo_tpu.utils import events, faults
-from albedo_tpu.utils.jsonio import atomic_write_json
+from albedo_tpu.utils.jsonio import atomic_write_json, read_json_or_none
+from albedo_tpu.utils.quarantine import quarantine_rename
 
 log = logging.getLogger(__name__)
 
 T = TypeVar("T")
 
 MANIFEST_SUFFIX = ".sha256"
+META_SUFFIX = ".meta.json"
 
 _LOAD_FAULT = faults.site("artifact.load")
 _SAVE_FAULT = faults.site("artifact.save")
@@ -103,6 +104,15 @@ def write_manifest(path: Path) -> Path:
     })
 
 
+def read_manifest_sha(path: Path) -> str | None:
+    """The recorded content hash from ``path``'s manifest sidecar, or None
+    (missing/garbage manifest)."""
+    try:
+        return str(json.loads(manifest_path(Path(path)).read_text())["sha256"])
+    except (OSError, ValueError, KeyError):
+        return None
+
+
 def verify_manifest(path: Path) -> bool | None:
     """True = hash matches, False = mismatch (corruption), None = no/unreadable
     manifest (pre-manifest artifact: loadable but unverifiable)."""
@@ -118,19 +128,43 @@ def verify_manifest(path: Path) -> bool | None:
 
 
 def quarantine(path: Path, reason: str = "corrupt") -> Path:
-    """Move a bad artifact (and its manifest) aside to ``<name>.corrupt-<n>``
-    so the evidence survives for debugging while the slot regenerates."""
+    """Move a bad artifact (with its ``.sha256`` manifest and ``.meta.json``
+    quality stamp) aside to ``<name>.corrupt-<n>`` so the evidence survives
+    for debugging while the slot regenerates. One shared convention
+    (``utils.quarantine``) with the serving hot-swap manager and the ingest
+    row validator."""
+    return quarantine_rename(Path(path), reason=reason)
+
+
+# --- the quality stamp --------------------------------------------------------
+# Written at publish time by the pipeline's canary gate; verified by the
+# serving reload's stamp gate. A second sidecar (beside the .sha256 manifest)
+# because it answers a different question: the manifest says "these are the
+# bytes that were written", the stamp says "this artifact earned publication"
+# — lineage (input data hash, row/quarantine counts), watchdog trips, and
+# the canary score the gate compared.
+
+
+def meta_path(path: Path) -> Path:
+    return Path(path).with_name(Path(path).name + META_SUFFIX)
+
+
+def write_meta(path: Path, meta: dict) -> Path:
+    """Stamp ``path`` with its quality metadata (atomic write). The
+    artifact's content hash is recorded inside the stamp so a stamp can
+    never vouch for different bytes than it was issued against."""
     path = Path(path)
-    for n in itertools.count(1):
-        dest = path.with_name(f"{path.name}.corrupt-{n}")
-        if not dest.exists():
-            break
-    path.rename(dest)
-    mpath = manifest_path(path)
-    if mpath.exists():
-        mpath.rename(dest.with_name(dest.name + MANIFEST_SUFFIX))
-    log.warning("quarantined artifact %s -> %s (%s)", path.name, dest.name, reason)
-    return dest
+    payload = dict(meta)
+    payload.setdefault("artifact", path.name)
+    payload["sha256"] = file_sha256(path)
+    payload.setdefault("stamped_at", time.time())
+    return atomic_write_json(meta_path(path), payload, indent=2)
+
+
+def read_meta(path: Path) -> dict | None:
+    """The quality stamp for ``path``, or None (unstamped / unreadable)."""
+    meta = read_json_or_none(meta_path(Path(path)))
+    return meta if isinstance(meta, dict) else None
 
 
 def _remove(path: Path) -> None:

@@ -77,6 +77,43 @@ class StarMatrix:
         keep.sort()
         return StarMatrix(user_ids, item_ids, rows[keep], cols[keep], vals[keep])
 
+    @staticmethod
+    def from_codes(
+        user_vocab: np.ndarray,
+        item_vocab: np.ndarray,
+        user_codes: np.ndarray,
+        item_codes: np.ndarray,
+        vals: np.ndarray | None = None,
+    ) -> "StarMatrix":
+        """Build from a pre-computed factorization: dense codes into SORTED
+        raw-id vocabularies (the ingest validator's ``Factorization``).
+
+        Skips :meth:`from_interactions`' unique/dedup sorts — the caller
+        guarantees every code is in-range and (user, item) pairs are unique
+        (the validator's dangling and duplicate rules under strict/repair).
+        Vocabularies are compacted to the ids actually present, so the
+        result is byte-identical to ``from_interactions`` over the same
+        rows; only bincount/cumsum/gather passes remain, which is why the
+        validated ingest path costs no more than the bare one.
+        """
+        user_vocab = np.asarray(user_vocab, dtype=np.int64)
+        item_vocab = np.asarray(item_vocab, dtype=np.int64)
+        user_codes = np.asarray(user_codes, dtype=np.int64)
+        item_codes = np.asarray(item_codes, dtype=np.int64)
+        if vals is None:
+            vals = np.ones(user_codes.shape[0], dtype=np.float32)
+        present_u = np.bincount(user_codes, minlength=user_vocab.shape[0]) > 0
+        present_i = np.bincount(item_codes, minlength=item_vocab.shape[0]) > 0
+        remap_u = np.cumsum(present_u) - 1
+        remap_i = np.cumsum(present_i) - 1
+        return StarMatrix(
+            user_ids=user_vocab[present_u],
+            item_ids=item_vocab[present_i],
+            rows=remap_u[user_codes].astype(np.int32),
+            cols=remap_i[item_codes].astype(np.int32),
+            vals=np.asarray(vals, dtype=np.float32),
+        )
+
     def users_of(self, raw_user_ids: np.ndarray) -> np.ndarray:
         """Map raw user ids to dense indices (-1 for unknown)."""
         return _lookup(self.user_ids, raw_user_ids)

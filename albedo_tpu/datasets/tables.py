@@ -12,7 +12,7 @@ from __future__ import annotations
 
 import dataclasses
 from pathlib import Path
-from typing import Callable
+from typing import Any, Callable
 
 import numpy as np
 import pandas as pd
@@ -182,16 +182,61 @@ class RawTables:
             relation=conform(self.relation, RELATION_SCHEMA),
         )
 
-    def star_matrix(self) -> StarMatrix:
+    def star_matrix(self, policy: str | None = None) -> StarMatrix:
         """The implicit-rating matrix (``loadRawStarringDS`` adds
         ``starring = 1.0``; ``DatasetUtils.scala:111-121``), interactions kept
-        in starred_at order so truncation keeps the most recent."""
-        s = self.starring.sort_values("starred_at", kind="stable")
-        return StarMatrix.from_interactions(
-            raw_users=s["user_id"].to_numpy(np.int64),
-            raw_items=s["repo_id"].to_numpy(np.int64),
-            vals=np.ones(len(s), dtype=np.float32),
+        in starred_at order so truncation keeps the most recent.
+
+        ``policy`` routes the rows through the data-quality firewall
+        (``datasets.validate``) first: ``"strict"`` raises on any violation,
+        ``"repair"`` drops flagged rows, ``None``/``"off"`` is the bare seed
+        path (library callers that own their data skip the firewall; the CLI
+        jobs pass their ``--data-policy`` via :meth:`validated_star_matrix`).
+        """
+        return self.validated_star_matrix(policy=policy or "off")[0]
+
+    def validated_star_matrix(
+        self,
+        policy: str | None = None,
+        quarantine_name: str | None = None,
+        now: float | None = None,
+    ) -> tuple[StarMatrix, "Any"]:
+        """``star_matrix`` through the ingest firewall; returns
+        ``(matrix, ValidationReport)``. Rows are recency-sorted BEFORE
+        validation so the duplicate rule's keep-last is keep-most-recent —
+        byte-identical survivors to the implicit dedup the matrix build
+        always applied."""
+        from albedo_tpu.datasets.validate import (
+            validate_and_factorize,
+            validate_matrix,
         )
+
+        s = self.starring.sort_values("starred_at", kind="stable")
+        s, report, fact = validate_and_factorize(
+            s,
+            user_vocab=self.user_info["user_id"].to_numpy(np.int64)
+            if len(self.user_info) else None,
+            repo_vocab=self.repo_info["repo_id"].to_numpy(np.int64)
+            if len(self.repo_info) else None,
+            now=now,
+            policy=policy,
+            quarantine_name=quarantine_name,
+        )
+        if fact is not None:
+            # strict/repair survivors carry in-range codes and unique pairs,
+            # so the matrix build reuses the validator's factorization and
+            # skips from_interactions' unique/dedup sorts entirely.
+            matrix = StarMatrix.from_codes(
+                fact.user_vocab, fact.repo_vocab, fact.user_codes, fact.repo_codes
+            )
+        else:
+            matrix = StarMatrix.from_interactions(
+                raw_users=s["user_id"].to_numpy(np.int64),
+                raw_items=s["repo_id"].to_numpy(np.int64),
+                vals=np.ones(len(s), dtype=np.float32),
+            )
+        validate_matrix(matrix, policy=policy or "off")
+        return matrix, report
 
 
 def popular_repos(

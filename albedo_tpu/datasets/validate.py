@@ -1,0 +1,449 @@
+"""Declarative data-quality firewall for raw star ingest.
+
+PR 3 made the offline chain survive crashes and PR 4 made serving survive
+bad artifacts at load time; this module stops trusting the DATA. The ALX
+posture (arxiv 2112.02194) treats end-to-end input sanity as a precondition
+for dependable large-scale ALS, and the reference's Estimator/Transformer
+chain (arxiv 1505.06807) assumes each stage can trust its upstream — the
+validator makes that true by construction: every raw star row passes a
+declarative rule catalog before it can become a matrix nonzero.
+
+Rules (the catalog ARCHITECTURE.md "Data quality" documents) run as
+vectorized numpy masks over ONE shared factorization of the frame (raw ids
+-> dense codes into the sorted vocabularies, built with a single
+``searchsorted`` per column) — no per-row Python, and no sort the matrix
+build would repeat: :func:`validate_and_factorize` hands the codes to
+``StarMatrix.from_codes``, which skips ``from_interactions``' unique/dedup
+sorts entirely. That sharing is how the firewall stays inside the
+5%-of-ingest overhead budget the ``bench.py datacheck`` scenario enforces
+(in practice the validated build is *faster* than the bare path — the
+validator's factorization replaces the heavier one the matrix build would
+have done):
+
+==========================  ===================================================
+rule                        flags
+==========================  ===================================================
+``dangling_user``           ``user_id`` absent from the user_info vocabulary
+``dangling_repo``           ``repo_id`` absent from the repo_info vocabulary
+``duplicate_pair``          all but the last *otherwise-valid* occurrence of a
+                            (user, repo) pair (callers pass recency-sorted
+                            rows, so "last" is the most recent star — the same
+                            keep-last the matrix dedup applied implicitly
+                            before; a corrupt newest duplicate is dropped
+                            under its own rule and never costs the pair its
+                            surviving valid row)
+``nonpositive_confidence``  ``starring`` <= 0 or NaN (implicit-feedback
+                            confidences must be positive)
+``timestamp_range``         ``starred_at`` NaN, <= 0, or in the future
+                            (beyond ``now`` + 1 day of clock skew)
+``dense_user``              "poison" users starring a suspiciously large
+                            fraction of the catalog — DISTINCT repos per user
+                            (duplicated crawl rows don't inflate the count)
+                            vs the observed catalog (injection/crawler-loop
+                            signature); all their rows are flagged
+==========================  ===================================================
+
+Violations are counted per rule in the process-global
+``albedo_data_violations_total{rule=}`` (``utils.events``) — every
+`/metrics` render shows them — and handled per policy:
+
+- ``strict``  any violation raises :class:`DataValidationError` (the full
+  report attached);
+- ``repair``  violating rows are dropped, and (when a ``quarantine_name``
+  is given) written to a reviewable ``<name>.quarantine-<n>.csv`` sidecar
+  in the artifact store, one ``rule`` column per row — the row-level
+  analogue of the store's ``.corrupt-<n>`` convention
+  (``utils.quarantine``);
+- ``off``     passthrough (the seed's behavior; dedup still happens later
+  inside ``StarMatrix.from_interactions``).
+
+The ``data.validate`` fault site fires at the head of a validation pass so
+chaos drills can fail or delay ingest deterministically.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import logging
+import math
+import os
+from typing import TYPE_CHECKING
+
+import numpy as np
+
+from albedo_tpu.utils import events, faults
+
+if TYPE_CHECKING:  # pragma: no cover
+    import pandas as pd
+
+    from albedo_tpu.datasets.star_matrix import StarMatrix
+
+log = logging.getLogger(__name__)
+
+POLICIES = ("strict", "repair", "off")
+_POLICY_ENV = "ALBEDO_DATA_POLICY"
+
+_VALIDATE_FAULT = faults.site("data.validate")
+
+# A starred_at more than this far past `now` is a corrupt clock, not skew.
+FUTURE_SLACK_S = 86_400.0
+
+
+def default_policy() -> str:
+    """Process default: ``$ALBEDO_DATA_POLICY`` or ``repair``."""
+    return os.environ.get(_POLICY_ENV, "repair")
+
+
+class DataValidationError(ValueError):
+    """Strict-policy failure; ``report`` carries the per-rule counts."""
+
+    def __init__(self, report: "ValidationReport"):
+        super().__init__(
+            f"{report.total} raw star row(s) violate ingest invariants "
+            f"under --data-policy strict: {report.violations}"
+        )
+        self.report = report
+
+
+@dataclasses.dataclass
+class ValidationReport:
+    """One validation pass: what came in, what was flagged, what survived."""
+
+    policy: str
+    rows_in: int = 0
+    rows_out: int = 0
+    violations: dict[str, int] = dataclasses.field(default_factory=dict)
+    quarantined_to: str | None = None
+
+    @property
+    def total(self) -> int:
+        return int(sum(self.violations.values()))
+
+    def to_dict(self) -> dict:
+        return {
+            "policy": self.policy,
+            "rows_in": int(self.rows_in),
+            "rows_out": int(self.rows_out),
+            "violations": {k: int(v) for k, v in self.violations.items()},
+            "quarantined": self.total if self.policy != "off" else 0,
+            "quarantined_to": self.quarantined_to,
+        }
+
+
+def dense_user_threshold(
+    n_distinct_items: int, frac: float | None = None, floor: int | None = None
+) -> int:
+    """Stars-per-user count at which a user is flagged ``dense_user``.
+
+    ``max(floor, ceil(frac * catalog))`` — fraction-of-catalog because raw
+    counts mean nothing across dataset sizes; the floor keeps tiny catalogs
+    (where an enthusiast legitimately stars most things) out of the rule.
+    Env overrides: ``ALBEDO_DENSE_USER_FRAC`` / ``ALBEDO_DENSE_USER_MIN``.
+    """
+    if frac is None:
+        frac = float(os.environ.get("ALBEDO_DENSE_USER_FRAC", "0.8"))
+    if floor is None:
+        floor = int(os.environ.get("ALBEDO_DENSE_USER_MIN", "20"))
+    return max(int(floor), int(math.ceil(frac * max(0, n_distinct_items))))
+
+
+@dataclasses.dataclass
+class Factorization:
+    """Raw-id -> dense-code factorization shared between validation and the
+    matrix build (``StarMatrix.from_codes``). ``*_vocab`` are the sorted
+    distinct raw ids the codes index into (the entity-table vocabulary when
+    one was given, else the ids observed in the frame); ``*_codes`` align
+    with the CLEAN frame :func:`validate_and_factorize` returns — every code
+    is in-range (dangling rows were dropped) and (user, repo) pairs are
+    unique (the duplicate rule keeps the most recent)."""
+
+    user_vocab: np.ndarray
+    repo_vocab: np.ndarray
+    user_codes: np.ndarray
+    repo_codes: np.ndarray
+
+
+def _factorize(
+    ids: np.ndarray, vocab: np.ndarray | None
+) -> tuple[np.ndarray, np.ndarray, np.ndarray | None]:
+    """``(codes, sorted_vocab, dangling_mask)`` for one id column.
+
+    With a vocabulary: one sort of the (small) vocab + one ``searchsorted``
+    of the rows — the same O(n log m) ``np.isin`` costs, but the positions
+    are kept as codes instead of thrown away. Without one (absent/empty
+    entity table: nothing to validate against), the observed ids factorize
+    via ``np.unique`` and no dangling mask is emitted."""
+    if vocab is not None and len(vocab):
+        sv = np.sort(np.asarray(vocab, dtype=np.int64))
+        pos = np.minimum(np.searchsorted(sv, ids), sv.shape[0] - 1)
+        found = sv[pos] == ids
+        return np.where(found, pos, -1), sv, ~found
+    uniq, inv = np.unique(ids, return_inverse=True)
+    return inv.astype(np.int64), uniq, None
+
+
+def _rule_masks(
+    s: "pd.DataFrame",
+    fact: Factorization,
+    user_dangling: np.ndarray | None,
+    repo_dangling: np.ndarray | None,
+    now: float | None,
+) -> list[tuple[str, np.ndarray]]:
+    """(rule, bad-row mask) per catalog rule, in documented order. All masks
+    derive from the shared factorization — no additional full-column sort."""
+    n = len(s)
+    masks: list[tuple[str, np.ndarray]] = []
+    user_codes = fact.user_codes
+    repo_codes = fact.repo_codes
+
+    # Dangling ids: only enforceable against a non-empty vocabulary — an
+    # absent/empty entity table means "nothing to validate against", not
+    # "every row dangles".
+    if user_dangling is not None:
+        masks.append(("dangling_user", user_dangling))
+    if repo_dangling is not None:
+        masks.append(("dangling_repo", repo_dangling))
+
+    # Row-local validity first: duplicate keep-last must crown the newest
+    # OTHERWISE-VALID occurrence of a pair — if the newest duplicate is
+    # itself corrupt (NaN timestamp sorts last, bad confidence, dangling
+    # id), flagging the valid earlier row as "the duplicate" would make
+    # the pair vanish entirely under repair.
+    bad_conf = np.zeros(n, dtype=bool)
+    if "starring" in s.columns:
+        conf = s["starring"].to_numpy(np.float64)
+        bad_conf = ~(conf > 0)  # catches NaN too
+    bad_ts = np.zeros(n, dtype=bool)
+    if "starred_at" in s.columns:
+        ts = s["starred_at"].to_numpy(np.float64)
+        bad_ts = ~(ts > 0)  # NaN or non-positive epoch
+        if now is not None:
+            bad_ts |= ts > float(now) + FUTURE_SLACK_S
+
+    # Duplicate (user, repo) pairs via a single int64 pair key over the
+    # codes — a hash-table duplicated() pass instead of a two-column sort.
+    # Rows already condemned by a row-local rule get a unique sentinel key:
+    # they are flagged (and dropped) under their own rule and neither
+    # compete for keep-last nor count as duplicates of each other.
+    import pandas as pd
+
+    key = user_codes * np.int64(fact.repo_vocab.shape[0] + 1) + repo_codes
+    invalid = (user_codes < 0) | (repo_codes < 0) | bad_conf | bad_ts
+    if invalid.any():
+        key[invalid] = -np.arange(1, int(invalid.sum()) + 1, dtype=np.int64)
+    dup = pd.Series(key).duplicated(keep="last").to_numpy()
+    masks.append(("duplicate_pair", dup))
+
+    if "starring" in s.columns:
+        masks.append(("nonpositive_confidence", bad_conf))
+    if "starred_at" in s.columns:
+        masks.append(("timestamp_range", bad_ts))
+
+    # Poison users: per-user DISTINCT-repo counts vs the observed catalog
+    # size, over rows no other rule already condemned — duplicated crawl
+    # rows must not inflate a legitimate user toward the threshold.
+    valid_pair = ~invalid & ~dup
+    counts = np.bincount(
+        user_codes[valid_pair], minlength=fact.user_vocab.shape[0]
+    )
+    n_distinct_repos = int(
+        (np.bincount(
+            repo_codes[valid_pair], minlength=fact.repo_vocab.shape[0]
+        ) > 0).sum()
+    )
+    threshold = dense_user_threshold(n_distinct_repos)
+    dense = counts >= threshold
+    if dense.any():
+        valid_u = user_codes >= 0
+        masks.append(
+            ("dense_user", valid_u & dense[np.maximum(user_codes, 0)])
+        )
+    else:
+        masks.append(("dense_user", np.zeros(n, dtype=bool)))
+    return masks
+
+
+def validate_starring(
+    starring: "pd.DataFrame",
+    *,
+    user_vocab: np.ndarray | None = None,
+    repo_vocab: np.ndarray | None = None,
+    now: float | None = None,
+    policy: str | None = None,
+    quarantine_name: str | None = None,
+) -> tuple["pd.DataFrame", ValidationReport]:
+    """Run the rule catalog over a starring frame; returns (clean, report).
+
+    ``policy=None`` resolves :func:`default_policy`. Under ``repair`` the
+    surviving frame has every flagged row dropped; under ``strict`` any
+    violation raises :class:`DataValidationError` (after counting ALL
+    rules, so the report is complete); ``off`` returns the frame untouched
+    with an empty report. Duplicate handling keeps the LAST occurrence —
+    callers pass recency-sorted rows so this matches the keep-most-recent
+    dedup ``StarMatrix.from_interactions`` applies.
+    """
+    clean, report, _ = validate_and_factorize(
+        starring,
+        user_vocab=user_vocab,
+        repo_vocab=repo_vocab,
+        now=now,
+        policy=policy,
+        quarantine_name=quarantine_name,
+    )
+    return clean, report
+
+
+def validate_and_factorize(
+    starring: "pd.DataFrame",
+    *,
+    user_vocab: np.ndarray | None = None,
+    repo_vocab: np.ndarray | None = None,
+    now: float | None = None,
+    policy: str | None = None,
+    quarantine_name: str | None = None,
+) -> tuple["pd.DataFrame", ValidationReport, Factorization | None]:
+    """:func:`validate_starring` that also returns the :class:`Factorization`
+    the rules ran on, aligned with the clean frame — the matrix build
+    (``StarMatrix.from_codes``) reuses it instead of repeating the unique/
+    dedup sorts, which is what keeps the validated ingest path as fast as
+    the bare one. ``None`` factorization under ``policy="off"`` (nothing was
+    computed)."""
+    policy = policy or default_policy()
+    if policy not in POLICIES:
+        raise ValueError(f"unknown data policy {policy!r} (one of {POLICIES})")
+    report = ValidationReport(policy=policy, rows_in=len(starring), rows_out=len(starring))
+    if policy == "off":
+        return starring, report, None
+
+    # Chaos hook: fail/delay the ingest validation pass itself.
+    _VALIDATE_FAULT.hit()
+
+    user_codes, uvocab, user_dangling = _factorize(
+        starring["user_id"].to_numpy(np.int64), user_vocab
+    )
+    repo_codes, rvocab, repo_dangling = _factorize(
+        starring["repo_id"].to_numpy(np.int64), repo_vocab
+    )
+    fact = Factorization(uvocab, rvocab, user_codes, repo_codes)
+    masks = _rule_masks(starring, fact, user_dangling, repo_dangling, now)
+    bad_any = np.zeros(len(starring), dtype=bool)
+    rules_per_row: list[tuple[str, np.ndarray]] = []
+    for rule, mask in masks:
+        count = int(mask.sum())
+        if not count:
+            continue
+        report.violations[rule] = count
+        events.data_violations.inc(count, rule=rule)
+        rules_per_row.append((rule, mask))
+        bad_any |= mask
+
+    if not bad_any.any():
+        return starring, report, fact
+
+    if policy == "strict":
+        raise DataValidationError(report)
+
+    # repair: quarantine the evidence (reviewable, rule-tagged), drop the rows.
+    if quarantine_name is not None:
+        report.quarantined_to = _write_row_quarantine(
+            quarantine_name, starring, rules_per_row, bad_any
+        )
+    clean = starring.loc[~bad_any]
+    keep = ~bad_any
+    fact = Factorization(uvocab, rvocab, user_codes[keep], repo_codes[keep])
+    report.rows_out = len(clean)
+    log.warning(
+        "data-quality firewall dropped %d/%d star row(s): %s%s",
+        int(bad_any.sum()), len(starring), report.violations,
+        f" (quarantined to {report.quarantined_to})" if report.quarantined_to else "",
+    )
+    return clean, report, fact
+
+
+def _write_row_quarantine(
+    name: str,
+    starring: "pd.DataFrame",
+    rules_per_row: list[tuple[str, np.ndarray]],
+    bad_any: np.ndarray,
+) -> str | None:
+    """Write the flagged rows + their rule tags to a reviewable CSV sidecar
+    in the artifact store (``<name>.quarantine-<n>.csv``)."""
+    from albedo_tpu.datasets.artifacts import artifact_path
+    from albedo_tpu.utils.quarantine import ROWS_MARKER, next_marked_path
+
+    try:
+        rules = np.full(len(starring), "", dtype=object)
+        for rule, mask in rules_per_row:
+            hit = mask & (rules != "")
+            rules[hit] = [f"{r},{rule}" for r in rules[hit]]
+            rules[mask & ~hit] = rule
+        frame = starring.loc[bad_any].copy()
+        frame["rule"] = rules[bad_any]
+        dest = next_marked_path(artifact_path(name), ROWS_MARKER, suffix=".csv")
+        frame.to_csv(dest, index=False)
+        return dest.name
+    except OSError as e:  # pragma: no cover — quarantine is best-effort
+        log.warning("could not write row quarantine sidecar for %s: %r", name, e)
+        return None
+
+
+# --- matrix-level invariants --------------------------------------------------
+
+
+def validate_matrix(matrix: "StarMatrix", policy: str | None = None) -> ValidationReport:
+    """Post-build invariants on the assembled star matrix: indices in range,
+    finite positive confidences, no degenerate all-zero rows/cols (a user or
+    item whose every confidence is zero contributes a zero normal-equation
+    block that solves to garbage factors). Counted under the same metric;
+    ``strict`` raises, ``repair``/``off`` only report (matrix surgery
+    belongs in the row pass — by the time a matrix exists the rows already
+    passed, so a violation here means a BUG upstream, worth surfacing)."""
+    policy = policy or default_policy()
+    report = ValidationReport(policy=policy, rows_in=matrix.nnz, rows_out=matrix.nnz)
+    if policy == "off":
+        return report
+    checks: dict[str, int] = {}
+    if matrix.nnz:
+        oob = int(
+            ((matrix.rows < 0) | (matrix.rows >= matrix.n_users)
+             | (matrix.cols < 0) | (matrix.cols >= matrix.n_items)).sum()
+        )
+        if oob:
+            checks["index_out_of_range"] = oob
+        nonpos = int((~(matrix.vals > 0)).sum())  # NaN and <= 0
+        if nonpos:
+            # All-positive vals make an all-zero row/col impossible, so the
+            # (heavier) degenerate-row scan only runs when zeros slipped in.
+            checks["nonpositive_confidence"] = nonpos
+            row_sums = np.bincount(
+                matrix.rows, weights=np.abs(matrix.vals), minlength=matrix.n_users
+            )
+            col_sums = np.bincount(
+                matrix.cols, weights=np.abs(matrix.vals), minlength=matrix.n_items
+            )
+            present_r = np.bincount(matrix.rows, minlength=matrix.n_users) > 0
+            present_c = np.bincount(matrix.cols, minlength=matrix.n_items) > 0
+            zero_rows = int((present_r & (row_sums == 0)).sum())
+            zero_cols = int((present_c & (col_sums == 0)).sum())
+            if zero_rows:
+                checks["all_zero_row"] = zero_rows
+            if zero_cols:
+                checks["all_zero_col"] = zero_cols
+    for rule, count in checks.items():
+        report.violations[rule] = count
+        events.data_violations.inc(count, rule=rule)
+    if checks and policy == "strict":
+        raise DataValidationError(report)
+    return report
+
+
+def matrix_fingerprint(matrix: "StarMatrix") -> str:
+    """Content hash of the assembled training data — the lineage field of
+    the ``.meta.json`` quality stamp. Covers shapes, vocabularies, and every
+    nonzero, so two stamps agree iff the models trained on identical input."""
+    h = hashlib.sha256()
+    h.update(np.int64([matrix.n_users, matrix.n_items, matrix.nnz]).tobytes())
+    for arr in (matrix.user_ids, matrix.item_ids, matrix.rows, matrix.cols, matrix.vals):
+        h.update(np.ascontiguousarray(arr).tobytes())
+    return h.hexdigest()

@@ -73,13 +73,19 @@ def user_items_from_pairs(
     (``RankingEvaluator.scala:121-143``): rank within each user by
     ``order_key`` DESCENDING (e.g. score, or starred_at), keep the top ``k``.
     Ties broken by input order (the reference's ``rank()`` keeps ties
-    nondeterministically; stable sort here makes tests reproducible).
+    nondeterministically; stable sort here makes tests reproducible). NaN
+    scores — a diverged model's output — rank LAST deterministically
+    (negated NaN would otherwise sort ahead of every real score and shuffle
+    with the platform's NaN ordering), which the canary publish gate relies
+    on: garbage scores must depress NDCG, not inflate it.
     """
     users = np.asarray(users)
     items = np.asarray(items, dtype=np.int32)
     if order_key is None:
         order_key = -np.arange(users.shape[0], dtype=np.float64)  # input order
-    order = np.lexsort((-np.asarray(order_key, dtype=np.float64), users))
+    key = np.asarray(order_key, dtype=np.float64)
+    key = np.where(np.isnan(key), -np.inf, key)
+    order = np.lexsort((-key, users))
     u_sorted = users[order]
     uniq, starts = np.unique(u_sorted, return_index=True)
     bounds = np.append(starts[1:], u_sorted.shape[0])

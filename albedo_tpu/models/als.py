@@ -532,8 +532,15 @@ class ImplicitALS:
         # the full computation: on the tunneled axon backend,
         # block_until_ready has been observed returning before execution
         # finishes (r5), while a d2h read of a dependent value provably
-        # orders after the producing program. ~4 bytes each, one round-trip.
-        np.asarray(user_f[0, :1]), np.asarray(item_f[0, :1])
+        # orders after the producing program. The value read is the
+        # divergence watchdog's on-device health vector (nonfinite count /
+        # max-abs / RMS over BOTH factor tables, utils.watchdog) — it
+        # depends on every factor element, so one ~12-byte round-trip both
+        # orders after the fit AND surfaces per-fit solve sanity with zero
+        # added host syncs on the happy path.
+        from albedo_tpu.utils.watchdog import factor_health, health_dict
+
+        health = health_dict(factor_health(user_f, item_f))
         t2 = time.perf_counter()
         self.last_fit_report = {
             "prep_s": round(t1 - t0, 4),
@@ -543,6 +550,7 @@ class ImplicitALS:
             "compile_source": compile_source,
             "device_s": round(t2 - t1 - compile_s, 4),
             "prep_cached": bool(cache_warm),
+            "health": health,
         }
 
         return ALSModel(user_factors=user_f, item_factors=item_f, rank=self.rank)

@@ -117,6 +117,7 @@ class LogisticRegression:
         fm: FeatureMatrix,
         labels: np.ndarray,
         sample_weight: np.ndarray | None = None,
+        _damped_retry: bool = False,
     ) -> LogisticRegressionModel:
         n = fm.n_rows
         t_prep = time.perf_counter()
@@ -163,6 +164,22 @@ class LogisticRegression:
             params, loss = _run_adam(loss_fn, params, data, self.max_iter, self.learning_rate)
         else:
             raise ValueError(f"unknown solver {self.solver!r}")
+
+        # Divergence watchdog (utils.watchdog): the training loss is already
+        # read to host as the completion barrier, so a finiteness check is
+        # free. A non-finite loss (exploded L-BFGS line search, absurd adam
+        # step) trips kind="lr" and re-runs ONCE with damped (10x)
+        # regularization; a re-run that is still non-finite refuses to
+        # produce a model rather than shipping garbage coefficients.
+        from albedo_tpu.utils.watchdog import TrainingDiverged, check_lr_loss
+
+        if not check_lr_loss(float(loss)):
+            if _damped_retry:
+                raise TrainingDiverged(self.max_iter, ["lr"])
+            retry = dataclasses.replace(
+                self, reg_param=max(float(self.reg_param) * 10.0, 1e-2)
+            )
+            return retry.fit(fm, labels, sample_weight, _damped_retry=True)
 
         return LogisticRegressionModel(
             params=params, scales=scales, train_loss=float(loss),

@@ -14,10 +14,14 @@ One reload attempt (``request_reload`` — also what the artifact watcher,
 
     candidate artifact
         │  gate 1: manifest   (.sha256 sidecar verifies — corruption stops here)
-        │  gate 2: load       (unpickle + from_arrays; `reload.load` fault site)
-        │  gate 3: invariants (finite factors, rank/shape match the matrix;
+        │  gate 2: stamp      (.meta.json quality stamp from the pipeline's
+        │                      canary publish gate: content-hash binding, the
+        │                      canary verdict, no regression vs the promoted
+        │                      score; unstamped rejects under require_stamp)
+        │  gate 3: load       (unpickle + from_arrays; `reload.load` fault site)
+        │  gate 4: invariants (finite factors, rank/shape match the matrix;
         │                      `reload.validate` fault site)
-        │  gate 4: probe      (fixed-probe top-k smoke test, compared against
+        │  gate 5: probe      (fixed-probe top-k smoke test, compared against
         │                      the incumbent: finite scores, valid indices;
         │                      overlap/score-delta recorded)
         ▼
@@ -67,8 +71,8 @@ _LOAD_FAULT = faults.site("reload.load")
 _VALIDATE_FAULT = faults.site("reload.validate")
 
 # Sidecar/derived files never themselves reload candidates.
-_SKIP_SUFFIXES = (artifact_store.MANIFEST_SUFFIX, ".tmp")
-_SKIP_MARKERS = (".corrupt-", ".tmp")
+_SKIP_SUFFIXES = (artifact_store.MANIFEST_SUFFIX, artifact_store.META_SUFFIX, ".tmp")
+_SKIP_MARKERS = (".corrupt-", ".quarantine-", ".tmp")
 
 
 class ReloadRejected(Exception):
@@ -103,6 +107,8 @@ class HotSwapManager:
         probe_k: int | None = None,
         error_rate_threshold: float = 0.5,
         error_rate_min_requests: int = 10,
+        require_stamp: bool = False,
+        canary_tolerance: float = 0.10,
     ):
         self.service = service
         self.metrics = service.metrics
@@ -111,6 +117,20 @@ class HotSwapManager:
         self.probe_k = int(probe_k) if probe_k else service.default_k
         self.error_rate_threshold = float(error_rate_threshold)
         self.error_rate_min_requests = int(error_rate_min_requests)
+        # Stamp gate policy: require_stamp=True refuses UNSTAMPED candidates
+        # outright (closed-loop deployments where everything arrives through
+        # the pipeline's canary gate); False admits unstamped artifacts like
+        # pre-stamp ones (recorded "missing (unverified)") but still rejects
+        # a PRESENT stamp that failed its canary or regressed past tolerance.
+        self.require_stamp = bool(require_stamp)
+        self.canary_tolerance = float(canary_tolerance)
+        self._promoted_canary_score: float | None = None
+        # Effective stamp-gate baseline AFTER each promote, keyed by
+        # generation number — rollback() restores the re-promoted
+        # incumbent's own baseline so a rolled-back candidate's (higher)
+        # score can't keep gating out candidates better than what is
+        # actually serving.
+        self._gen_scores: dict[int, float | None] = {}
         matrix = service.matrix
         n_users = int(matrix.n_users) if matrix is not None else 0
         self._probe_dense = (
@@ -152,6 +172,65 @@ class HotSwapManager:
         if verdict is False:
             raise ReloadRejected("manifest", "sha256 checksum mismatch")
         report["gates"]["manifest"] = "ok" if verdict else "missing (unverified)"
+
+    def _gate_stamp(self, path: Path, report: dict) -> float | None:
+        """The publish-quality gate: verify the pipeline's ``.meta.json``
+        stamp BEFORE paying the unpickle. Returns the candidate's canary
+        score (None when unstamped and admitted)."""
+        meta = artifact_store.read_meta(path)
+        if meta is None:
+            if self.require_stamp:
+                events.publish_rejected.inc(gate="stamp")
+                raise ReloadRejected(
+                    "stamp",
+                    "unstamped artifact (no .meta.json quality stamp; this "
+                    "store requires canary-gated publishes)",
+                )
+            report["gates"]["stamp"] = "missing (unverified)"
+            return None
+        # Binding: the stamp records the content hash it was issued against;
+        # the .sha256 manifest was verified one gate earlier, so comparing
+        # hashes pins stamp -> bytes without re-hashing the artifact. A
+        # missing manifest falls back to hashing the file itself — a stamp
+        # carrying a hash must never vouch for different bytes just because
+        # the manifest sidecar was lost.
+        manifest = artifact_store.read_manifest_sha(path)
+        stamped_sha = str(meta.get("sha256", ""))
+        if manifest is None and stamped_sha:
+            manifest = artifact_store.file_sha256(path)
+        if manifest is not None and stamped_sha and stamped_sha != manifest:
+            events.publish_rejected.inc(gate="stamp")
+            raise ReloadRejected(
+                "stamp", "quality stamp was issued for different artifact bytes"
+            )
+        canary = meta.get("canary") or {}
+        score = canary.get("score")
+        score = None if score is None else float(score)
+        if canary.get("forced"):
+            # --publish-force is an explicit operator override: the stamp
+            # admits the candidate past the quality checks (binding above
+            # still applies), but the override stays visible in the report.
+            report["gates"]["stamp"] = {"canary_score": score, "forced": True}
+            return score
+        if canary.get("passed") is False:
+            events.publish_rejected.inc(gate="stamp")
+            raise ReloadRejected(
+                "stamp", f"stamp records a failed canary gate: {canary}"
+            )
+        if (
+            score is not None
+            and self._promoted_canary_score is not None
+            and score < self._promoted_canary_score * (1.0 - self.canary_tolerance)
+        ):
+            events.publish_rejected.inc(gate="stamp")
+            raise ReloadRejected(
+                "stamp",
+                f"canary score {score:.5f} regressed more than "
+                f"{self.canary_tolerance:.0%} below the promoted generation's "
+                f"{self._promoted_canary_score:.5f}",
+            )
+        report["gates"]["stamp"] = {"canary_score": score}
+        return score
 
     def _gate_load(self, path: Path, report: dict) -> ALSModel:
         try:
@@ -296,6 +375,7 @@ class HotSwapManager:
             # gate below must catch it (the corrupt-artifact-mid-serve drill).
             _LOAD_FAULT.hit(path=path)
             self._gate_manifest(path, report)
+            candidate_score = self._gate_stamp(path, report)
             model = self._gate_load(path, report)
             self._gate_invariants(model, report)
             probe_vals, probe_idx = self._gate_probe(model, report)
@@ -351,6 +431,12 @@ class HotSwapManager:
             displaced.batcher if displaced.batcher is not gen.batcher else None
         )
         self.metrics.reloads.inc(outcome="promoted")
+        if candidate_score is not None:
+            # The stamp gate's regression baseline follows the promoted
+            # generation: a later candidate must not score materially below
+            # what is serving NOW.
+            self._promoted_canary_score = candidate_score
+        self._gen_scores[number] = self._promoted_canary_score
         report.update(outcome="promoted", generation=number)
         log.info("promoted model generation %d from %s", number, path.name)
         return report
@@ -386,6 +472,9 @@ class HotSwapManager:
         # itself and quarantine-rename its own healthy artifact.
         self._error_baseline = None
         self._displaced_for_rollback = None
+        # The regression baseline follows what is SERVING: the incumbent's
+        # own recorded baseline, not the rolled-back candidate's score.
+        self._promoted_canary_score = self._gen_scores.get(incumbent.number)
         self.service.promote(incumbent)
         self.service.retire_batcher(
             bad.batcher if bad.batcher is not incumbent.batcher else None

@@ -237,14 +237,21 @@ class StepCheckpointer:
     def journal_path(self) -> Path:
         return self.directory / "journal.json"
 
-    def write_journal(self, status: str, step: int, max_iter: int) -> None:
-        """Atomic progress record: {status: running|preempted|complete}."""
-        atomic_write_json(self.journal_path(), {
+    def write_journal(
+        self, status: str, step: int, max_iter: int, extra: dict | None = None
+    ) -> None:
+        """Atomic progress record: {status: running|preempted|complete|
+        diverged}. ``extra`` merges additional keys (e.g. the divergence
+        watchdog's trip records)."""
+        payload = {
             "status": status,
             "step": int(step),
             "max_iter": int(max_iter),
             "updated_at": time.time(),
-        })
+        }
+        if extra:
+            payload.update(extra)
+        atomic_write_json(self.journal_path(), payload)
 
     def read_journal(self) -> dict | None:
         return read_json_or_none(self.journal_path())
@@ -257,6 +264,7 @@ def checkpointed_als_fit(
     every: int = 5,
     keep_last: int | None = None,
     preemption: PreemptionHandler | None = None,
+    watchdog=None,
 ):
     """Resumable ALS training: checkpoint factors every ``every`` iterations
     and resume from the latest checkpoint after a kill — the framework-level
@@ -273,10 +281,20 @@ def checkpointed_als_fit(
     honored at the next chunk boundary: the current factors are already
     checkpointed, the journal flips to ``preempted``, and :class:`Preempted`
     propagates for the CLI to turn into a clean resumable exit.
+
+    With a :class:`~albedo_tpu.utils.watchdog.DivergenceWatchdog`, every
+    chunk boundary runs the tripwires over the host factor copies the
+    checkpoint write materializes anyway (no added device syncs). A tripped
+    chunk is re-run ONCE from the previous checkpointed factors with f32
+    accumulation and damped regularization before the fit gives up with
+    ``TrainingDiverged`` (journal status ``diverged``); trips and
+    remediation outcomes are journaled under ``"watchdog"`` and counted in
+    ``albedo_watchdog_trips_total{kind=}``.
     """
     import dataclasses
 
     from albedo_tpu.models.als import ALSModel
+    from albedo_tpu.utils.watchdog import TrainingDiverged, damped
 
     if every < 1:
         # min(every, remaining) would pin the chunk size at 0 and loop
@@ -287,6 +305,12 @@ def checkpointed_als_fit(
     latest = ckpt.restore_latest()
     start = 0
     factors = None
+
+    def _journal_extra() -> dict | None:
+        if watchdog is not None and watchdog.trips:
+            return {"watchdog": watchdog.trips}
+        return None
+
     if latest is not None:
         start, arrays = latest
         if int(arrays["rank"]) != als.rank:
@@ -311,16 +335,31 @@ def checkpointed_als_fit(
     ckpt.write_journal("running", start, als.max_iter)
     while start < als.max_iter:
         n = min(every, als.max_iter - start)
-        model = dataclasses.replace(als, max_iter=n, init_factors=factors).fit(matrix)
-        start += n
+        prev = factors
+        model = dataclasses.replace(als, max_iter=n, init_factors=prev).fit(matrix)
         factors = (model.user_factors, model.item_factors)
+        if watchdog is not None and watchdog.check(start + n, *factors):
+            # Remediation: ONE damped re-run of this chunk from the factors
+            # the previous checkpoint already holds (prev is None only on
+            # the first chunk, where the damped estimator re-seeds).
+            model = dataclasses.replace(
+                damped(als), max_iter=n, init_factors=prev
+            ).fit(matrix)
+            factors = (model.user_factors, model.item_factors)
+            if watchdog.check(start + n, *factors):
+                ckpt.write_journal(
+                    "diverged", start, als.max_iter, extra=_journal_extra()
+                )
+                raise TrainingDiverged(start + n, watchdog.trips[-1]["kinds"])
+            watchdog.mark_remediated()
+        start += n
         ckpt.save(start, {
             "user_factors": factors[0], "item_factors": factors[1],
             "rank": np.int64(als.rank),
         })
         if preemption is not None and preemption.should_stop() and start < als.max_iter:
-            ckpt.write_journal("preempted", start, als.max_iter)
+            ckpt.write_journal("preempted", start, als.max_iter, extra=_journal_extra())
             raise Preempted(start, ckpt.directory)
-        ckpt.write_journal("running", start, als.max_iter)
-    ckpt.write_journal("complete", start, als.max_iter)
+        ckpt.write_journal("running", start, als.max_iter, extra=_journal_extra())
+    ckpt.write_journal("complete", start, als.max_iter, extra=_journal_extra())
     return ALSModel(user_factors=factors[0], item_factors=factors[1], rank=als.rank)

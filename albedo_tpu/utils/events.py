@@ -232,3 +232,24 @@ aot_fingerprint_mismatches = global_counter(
     "fingerprint did not match the exporting process's record.",
     ("name",),
 )
+# The data-quality firewall (PR 5): ingest violations, training divergence
+# trips, and refused publishes all surface on the same /metrics page.
+data_violations = global_counter(
+    "albedo_data_violations_total",
+    "Raw star rows flagged by the ingest validator, by rule "
+    "(datasets.validate; dropped under --data-policy repair, fatal under "
+    "strict).",
+    ("rule",),
+)
+watchdog_trips = global_counter(
+    "albedo_watchdog_trips_total",
+    "Training divergence watchdog tripwires fired, by kind "
+    "(nonfinite/norm/trajectory/lr).",
+    ("kind",),
+)
+publish_rejected = global_counter(
+    "albedo_publish_rejected_total",
+    "Artifacts refused publication or promotion, by gate "
+    "(canary = pipeline quality gate, stamp = serving reload stamp gate).",
+    ("gate",),
+)

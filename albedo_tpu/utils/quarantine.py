@@ -1,0 +1,68 @@
+"""One quarantine convention for every layer: ``<name>.corrupt-<n>``.
+
+The artifact store moves a bad artifact aside before regenerating it, the
+hot-swap manager moves a rejected candidate aside before the watcher can
+retry it, and the ingest validator moves bad *rows* aside before the star
+matrix is built. All three keep the evidence next to the original under a
+numbered marker suffix so operators can triage (and tests can assert) what
+was refused — this module owns the naming and the rename so the convention
+cannot drift between layers.
+
+Markers:
+
+- ``.corrupt-<n>``     whole files/directories that failed integrity or a
+                       validation gate (``quarantine_rename``);
+- ``.quarantine-<n>``  row-level sidecars the data validator writes — a
+                       reviewable CSV of the dropped rows, tagged per rule
+                       (``datasets.validate``).
+
+Sidecar files (the ``.sha256`` manifest, the ``.meta.json`` quality stamp)
+travel WITH the quarantined artifact: a stale sidecar left behind under the
+original name would vouch for whatever regenerates into that slot.
+"""
+
+from __future__ import annotations
+
+import itertools
+import logging
+from pathlib import Path
+
+log = logging.getLogger(__name__)
+
+CORRUPT_MARKER = ".corrupt-"
+ROWS_MARKER = ".quarantine-"
+
+# Sidecars that must follow a quarantined artifact to its new name.
+SIDECAR_SUFFIXES = (".sha256", ".meta.json")
+
+
+def next_marked_path(path: Path, marker: str = CORRUPT_MARKER, suffix: str = "") -> Path:
+    """First free ``<name><marker><n><suffix>`` next to ``path`` (1-based)."""
+    path = Path(path)
+    for n in itertools.count(1):
+        dest = path.with_name(f"{path.name}{marker}{n}{suffix}")
+        if not dest.exists():
+            return dest
+    raise AssertionError("unreachable")  # pragma: no cover
+
+
+def quarantine_rename(
+    path: Path,
+    reason: str = "corrupt",
+    sidecar_suffixes: tuple[str, ...] = SIDECAR_SUFFIXES,
+) -> Path:
+    """Move ``path`` (and its sidecars) aside to ``<name>.corrupt-<n>``.
+
+    The evidence survives for debugging while the slot regenerates; sidecars
+    are renamed alongside so no stale manifest/stamp vouches for the next
+    occupant of the original name.
+    """
+    path = Path(path)
+    dest = next_marked_path(path, CORRUPT_MARKER)
+    path.rename(dest)
+    for suf in sidecar_suffixes:
+        sidecar = path.with_name(path.name + suf)
+        if sidecar.exists():
+            sidecar.rename(dest.with_name(dest.name + suf))
+    log.warning("quarantined %s -> %s (%s)", path.name, dest.name, reason)
+    return dest

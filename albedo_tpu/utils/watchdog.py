@@ -1,0 +1,201 @@
+"""Training divergence watchdog: on-device health stats, tripwires, remediation.
+
+A diverging implicit-ALS fit rarely crashes — bf16 gathers under an
+aggressive alpha, a near-singular normal-equation block, or corrupt input
+rows produce factors that are NaN, inf, or merely enormous, and the fit
+"succeeds" into an artifact whose NDCG falls off a cliff. The ALX solve-
+sanity posture (arxiv 2112.02194) is to check the solve itself, not just
+its inputs; this module is that check for both device fits (ALS) and the
+LR ranker.
+
+Design constraints:
+
+- **No host syncs on the happy path.** ``factor_health`` is one fused
+  jitted reduction over the factor tables whose 3-float result depends on
+  EVERY factor element — so its device->host read doubles as the fit's
+  completion barrier (``models.als.ImplicitALS.fit`` previously read two
+  probe elements for exactly that ordering guarantee; the health read
+  replaces it, adding zero round-trips). Chunk-boundary checks in
+  ``checkpointed_als_fit`` run on the host copies the checkpoint write
+  materializes anyway.
+- **Remediate before giving up.** A tripped chunk is re-run ONCE from the
+  previous checkpointed factors with f32 gather accumulation and damped
+  (increased) regularization (:func:`damped`); only a trip that survives
+  remediation raises :class:`TrainingDiverged`. Every trip and every
+  remediation outcome lands in the fit journal and in
+  ``albedo_watchdog_trips_total{kind=}``.
+- **Fault-injectable.** The ``train.watchdog`` site fires inside every
+  check; an armed ``error`` kind scribbles NaN into the checked factors so
+  chaos drills exercise the real detect -> remediate -> journal path with
+  no hand-stubbing.
+
+Tripwire kinds: ``nonfinite`` (any NaN/inf factor), ``norm`` (factor RMS
+above an absolute ceiling), ``trajectory`` (RMS grew by more than
+``max_growth`` x since the last healthy check — explosion caught before it
+reaches inf), ``lr`` (non-finite LR training loss).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+
+import numpy as np
+
+from albedo_tpu.utils import events, faults
+from albedo_tpu.utils.faults import FaultInjected
+
+log = logging.getLogger(__name__)
+
+WATCHDOG_FAULT = faults.site("train.watchdog")
+
+_factor_health_jit = None
+
+
+def factor_health(user_f, item_f):
+    """Device-side health vector ``[nonfinite_count, max_abs, rms]`` over
+    both factor tables (float32, shape (3,)). Dispatched async — reading it
+    to host is the caller's synchronization point."""
+    global _factor_health_jit
+    if _factor_health_jit is None:
+        import jax
+        import jax.numpy as jnp
+
+        def _health(uf, vf):
+            def stats(x):
+                finite = jnp.isfinite(x)
+                safe = jnp.where(finite, x, 0.0)
+                return (
+                    (x.size - finite.sum()).astype(jnp.float32),
+                    jnp.max(jnp.abs(safe)),
+                    jnp.sqrt(jnp.mean(safe * safe)),
+                )
+
+            un, ua, ur = stats(uf)
+            vn, va, vr = stats(vf)
+            return jnp.stack([un + vn, jnp.maximum(ua, va), jnp.maximum(ur, vr)])
+
+        _factor_health_jit = jax.jit(_health)
+    return _factor_health_jit(user_f, item_f)
+
+
+def health_dict(health) -> dict:
+    """Host-readable form of a :func:`factor_health` vector (this read is
+    the d2h completion barrier when called on a device array)."""
+    h = np.asarray(health, dtype=np.float64)
+    return {
+        "nonfinite": int(h[0]),
+        "max_abs": float(h[1]),
+        "rms": float(h[2]),
+    }
+
+
+class TrainingDiverged(RuntimeError):
+    """A divergence tripwire survived remediation; the fit's factors are
+    garbage and must not be published."""
+
+    def __init__(self, step: int, kinds: list[str]):
+        super().__init__(
+            f"training diverged at step {step} ({'/'.join(kinds)}) and the "
+            f"damped re-run did not recover; refusing to produce factors"
+        )
+        self.step = step
+        self.kinds = kinds
+
+
+def damped(als):
+    """A one-chunk remediation estimator: f32 gather accumulation (drop the
+    bf16 fast path) and regularization damped UP by ``10x`` — the standard
+    stabilizers for an exploding implicit-ALS normal equation."""
+    return dataclasses.replace(
+        als, gather_dtype=None, reg_param=float(als.reg_param) * 10.0
+    )
+
+
+@dataclasses.dataclass
+class DivergenceWatchdog:
+    """Tripwire state across one fit's checks (chunk boundaries or final).
+
+    ``check`` returns the tripped kinds (empty = healthy) and records every
+    trip in ``trips`` (journal-ready dicts) and the process-global counter.
+    The RMS baseline for the trajectory tripwire only advances on healthy
+    checks, so a slow-motion explosion can't ratchet its own baseline up.
+    """
+
+    max_rms: float = 1e4
+    max_growth: float = 50.0
+    trips: list[dict] = dataclasses.field(default_factory=list)
+    _prev_rms: float | None = dataclasses.field(default=None, init=False)
+
+    def check(self, step: int, user_f: np.ndarray, item_f: np.ndarray) -> list[str]:
+        user_f = np.asarray(user_f)
+        item_f = np.asarray(item_f)
+        try:
+            WATCHDOG_FAULT.hit()
+        except FaultInjected:
+            # Chaos hook: a mid-fit NaN appears exactly as a real divergence
+            # would — the genuine detection + remediation path runs from here.
+            user_f = user_f.copy()
+            user_f.flat[0] = np.nan
+        kinds: list[str] = []
+        finite_u = np.isfinite(user_f)
+        finite_v = np.isfinite(item_f)
+        nonfinite = int(user_f.size - finite_u.sum()) + int(item_f.size - finite_v.sum())
+        if nonfinite:
+            kinds.append("nonfinite")
+        # Same statistic the device-side factor_health reports: the larger
+        # of the two tables' RMS over their finite entries-as-zero view.
+        rms_u = float(np.sqrt(np.mean(np.square(np.where(finite_u, user_f, 0.0)))))
+        rms_v = float(np.sqrt(np.mean(np.square(np.where(finite_v, item_f, 0.0)))))
+        rms = max(rms_u, rms_v)
+        if rms > self.max_rms:
+            kinds.append("norm")
+        if (
+            self._prev_rms is not None
+            and rms > self.max_growth * max(self._prev_rms, 1e-12)
+        ):
+            kinds.append("trajectory")
+        if kinds:
+            for kind in kinds:
+                events.watchdog_trips.inc(kind=kind)
+            self.trips.append({
+                "step": int(step), "kinds": kinds,
+                "nonfinite": nonfinite, "rms": rms, "remediated": False,
+            })
+            log.warning(
+                "divergence watchdog tripped at step %d: %s (nonfinite=%d rms=%.3g)",
+                step, kinds, nonfinite, rms,
+            )
+        else:
+            self._prev_rms = rms
+        return kinds
+
+    def mark_remediated(self) -> None:
+        """The damped re-run of the last tripped chunk checked healthy."""
+        if self.trips:
+            self.trips[-1]["remediated"] = True
+
+
+def guarded_fit(als, matrix, watchdog: DivergenceWatchdog | None = None):
+    """Fit with the watchdog on the FINAL factors (the non-checkpointed
+    path): check once, remediate once via a damped full re-fit, raise
+    :class:`TrainingDiverged` if the re-fit is still sick. Returns
+    ``(model, trips)``."""
+    wd = watchdog or DivergenceWatchdog()
+    model = als.fit(matrix)
+    if wd.check(als.max_iter, model.user_factors, model.item_factors):
+        log.warning("re-running diverged fit once with f32/damped regularization")
+        model = damped(als).fit(matrix)
+        if wd.check(als.max_iter, model.user_factors, model.item_factors):
+            raise TrainingDiverged(als.max_iter, wd.trips[-1]["kinds"])
+        wd.mark_remediated()
+    return model, wd.trips
+
+
+def check_lr_loss(loss: float) -> bool:
+    """True when an LR training loss is healthy; a non-finite loss counts a
+    ``kind="lr"`` trip (the caller re-runs damped, then raises)."""
+    if np.isfinite(loss):
+        return True
+    events.watchdog_trips.inc(kind="lr")
+    return False

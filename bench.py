@@ -1282,7 +1282,71 @@ def serving_bench() -> dict:
     return record
 
 
-SCENARIOS = {"serving": serving_bench}
+def datacheck_bench() -> dict:
+    """The `datacheck` scenario: validation overhead on the ingest path.
+
+    Times ``RawTables.validated_star_matrix`` with the firewall OFF vs
+    REPAIR over the same synthetic tables, interleaved A/B trials with
+    median reporting (the 2-vCPU bench box throttles; interleaving hits
+    both arms equally). The contract: validation must stay under 5% of
+    ingest wall-clock — the record carries the measured overhead and a
+    ``within_budget`` verdict. Env knobs: ALBEDO_DATACHECK_USERS/ITEMS/
+    MEAN_STARS/TRIALS.
+    """
+    import statistics
+
+    from albedo_tpu.datasets import synthetic_tables
+
+    n_users = int(os.environ.get("ALBEDO_DATACHECK_USERS", "20000"))
+    n_items = int(os.environ.get("ALBEDO_DATACHECK_ITEMS", "5000"))
+    mean_stars = float(os.environ.get("ALBEDO_DATACHECK_MEAN_STARS", "25"))
+    trials = int(os.environ.get("ALBEDO_DATACHECK_TRIALS", "5"))
+    budget_frac = 0.05
+
+    tables = synthetic_tables(
+        n_users=n_users, n_items=n_items, mean_stars=mean_stars, seed=42
+    )
+    nnz = len(tables.starring)
+
+    def run(policy: str) -> float:
+        t0 = time.perf_counter()
+        matrix, report = tables.validated_star_matrix(policy=policy)
+        elapsed = time.perf_counter() - t0
+        if policy == "repair" and report.total:
+            fail("datacheck", f"synthetic tables should be clean, got {report.violations}")
+        if matrix.nnz == 0:
+            fail("datacheck", "empty matrix out of the ingest path")
+        return elapsed
+
+    # Warm both arms once (first-touch pandas/numpy allocations), then
+    # interleave the timed trials.
+    run("off"), run("repair")
+    base_trials, val_trials = [], []
+    for _ in range(max(1, trials)):
+        base_trials.append(run("off"))
+        val_trials.append(run("repair"))
+    base = statistics.median(base_trials)
+    validated = statistics.median(val_trials)
+    overhead = (validated - base) / max(base, 1e-9)
+    return {
+        "metric": "datacheck_overhead_frac",
+        "unit": "fraction of ingest wall-clock",
+        "value": round(overhead, 4),
+        "within_budget": bool(overhead <= budget_frac),
+        "budget_frac": budget_frac,
+        "ingest_s_median": round(base, 4),
+        "validated_s_median": round(validated, 4),
+        "trials": {
+            "ingest_s": [round(t, 4) for t in base_trials],
+            "validated_s": [round(t, 4) for t in val_trials],
+        },
+        "n_users": n_users,
+        "n_items": n_items,
+        "star_rows": int(nnz),
+    }
+
+
+SCENARIOS = {"serving": serving_bench, "datacheck": datacheck_bench}
 
 
 if __name__ == "__main__":

@@ -88,3 +88,116 @@ def test_sigterm_preempts_cleanly_and_resumes(tmp_path):
     assert resumed.returncode == 0, resumed.stderr
     assert _ndcg(resumed) > 0
     assert '"status": "complete"' in journals[0].read_text()
+
+
+# --- the poisoned-pipeline drill (PR 5) ---------------------------------------
+
+
+def _write_poisoned_dataset(dest: Path) -> None:
+    """A CSV dataset seeding EVERY ingest violation class on top of coherent
+    synthetic tables: dangling user/repo ids, a duplicate (user, repo) star,
+    non-positive and NaN confidences, NaN/negative/future timestamps, and a
+    poison user starring most of the catalog."""
+    import numpy as np
+    import pandas as pd
+
+    from albedo_tpu.datasets import synthetic_tables
+
+    tables = synthetic_tables(n_users=120, n_items=80, mean_stars=10, seed=11)
+    s = tables.starring
+    now = 1_700_000_000.0
+    dense_uid = int(tables.user_info["user_id"].iloc[0])
+    dense_repos = tables.repo_info["repo_id"].to_numpy(np.int64)[:70]
+    first = s.iloc[0]
+    bad = pd.DataFrame({
+        "user_id": [-1, int(first["user_id"]), int(first["user_id"]),
+                    int(first["user_id"]), int(first["user_id"])],
+        "repo_id": [int(first["repo_id"]), -2, int(first["repo_id"]),
+                    int(tables.repo_info["repo_id"].iloc[1]),
+                    int(tables.repo_info["repo_id"].iloc[2])],
+        "starred_at": [now, now, now - 1.0,            # dup keeps the later
+                       np.nan, now + 30 * 86_400.0],   # NaN / future clock
+        "starring": [1.0, 1.0, 1.0, -3.0, np.nan],
+    })
+    poison = pd.DataFrame({
+        "user_id": np.full(len(dense_repos), dense_uid, np.int64),
+        "repo_id": dense_repos,
+        "starred_at": np.full(len(dense_repos), now - 86_400.0),
+        "starring": np.ones(len(dense_repos)),
+    })
+    dest.mkdir(parents=True, exist_ok=True)
+    tables.user_info.to_csv(dest / "user_info.csv", index=False)
+    tables.repo_info.to_csv(dest / "repo_info.csv", index=False)
+    tables.relation.to_csv(dest / "relation.csv", index=False)
+    pd.concat([s, bad, poison], ignore_index=True).to_csv(
+        dest / "starring.csv", index=False
+    )
+
+
+def _run_pipeline(env: dict, tables: Path, *extra: str) -> subprocess.CompletedProcess:
+    cmd = [
+        sys.executable, "-m", "albedo_tpu.cli", "run_pipeline", "--small",
+        "--tables", str(tables), "--data-policy", "repair",
+        "--checkpoint-every", "2", *extra,
+    ]
+    return subprocess.run(cmd, capture_output=True, text=True, env=env, timeout=580)
+
+
+def test_poisoned_pipeline_drill(tmp_path):
+    """Acceptance: a dataset seeded with every violation class plus an
+    injected mid-fit NaN runs the real CLI to completion under
+    ``--data-policy repair`` — violations quarantined + journaled, the
+    watchdog remediation journaled into the publish stamp — and a second
+    run whose canary gate fails exits 4 (a verdict, not a crash) with the
+    journal recording the refusal."""
+    import json
+
+    tables_dir = tmp_path / "tables"
+    _write_poisoned_dataset(tables_dir)
+    env = _env(tmp_path / "data")
+
+    # Run 1: poisoned ingest + a NaN scribbled into the first watchdog check.
+    proc = _run_pipeline(
+        {**env, "ALBEDO_FAULTS": "train.watchdog:error@1"}, tables_dir
+    )
+    assert proc.returncode == 0, (proc.returncode, proc.stdout, proc.stderr)
+
+    art_dir = tmp_path / "data"
+    journal_path = next(art_dir.rglob("*pipeline-journal.json"))
+    journal = json.loads(journal_path.read_text())
+    assert journal["status"] == "complete"
+    ingest = journal["stages"]["ingest"]["result"]
+    for rule in ("dangling_user", "dangling_repo", "duplicate_pair",
+                 "nonpositive_confidence", "timestamp_range", "dense_user"):
+        assert ingest["violations"].get(rule, 0) >= 1, rule
+    assert ingest["rows_out"] < ingest["rows_in"]
+    # The dropped rows are quarantined, reviewable, rule-tagged.
+    sidecar = next(art_dir.rglob("*.quarantine-*.csv"))
+    assert sidecar.name == ingest["quarantined_to"]
+    assert "rule" in sidecar.read_text().splitlines()[0]
+    # The published stamp records lineage, the canary verdict, AND the
+    # remediated mid-fit divergence.
+    meta = json.loads(next(art_dir.rglob("*alsModel*.pkl.meta.json")).read_text())
+    assert meta["canary"]["passed"] is True
+    assert meta["lineage"]["quarantined"] == ingest["violations"]
+    trips = meta["watchdog"]["trips"]
+    assert trips and trips[0]["kinds"] == ["nonfinite"]
+    assert trips[0]["remediated"] is True
+
+    # Run 2: an unreachable canary floor — the gate REFUSES to publish.
+    # Exit 4 is a verdict (retrain/investigate), distinct from 1 (crash)
+    # and 75 (preempted).
+    refused = _run_pipeline(env, tables_dir, "--canary-floor", "1.1")
+    assert refused.returncode == 4, (refused.returncode, refused.stderr)
+    assert "PUBLISH REFUSED" in refused.stdout
+    journal = json.loads(journal_path.read_text())
+    assert journal["status"] == "rejected"
+    assert journal["stages"]["canary"]["status"] == "rejected"
+
+    # Run 3: --publish-force overrides the same gate, loudly.
+    forced = _run_pipeline(env, tables_dir, "--canary-floor", "1.1",
+                           "--publish-force")
+    assert forced.returncode == 0, (forced.returncode, forced.stderr)
+    assert "CANARY GATE OVERRIDDEN" in forced.stdout
+    meta = json.loads(next(art_dir.rglob("*alsModel*.pkl.meta.json")).read_text())
+    assert meta["canary"]["forced"] is True

@@ -1,0 +1,298 @@
+"""The ingest data-quality firewall: rule catalog, policy matrix, quarantine
+sidecars, matrix invariants, and the lineage fingerprint
+(``datasets/validate.py``; ARCHITECTURE.md "Data quality")."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from albedo_tpu.datasets import synthetic_tables
+from albedo_tpu.datasets.artifacts import artifact_path
+from albedo_tpu.datasets.star_matrix import StarMatrix
+from albedo_tpu.datasets.validate import (
+    DataValidationError,
+    dense_user_threshold,
+    matrix_fingerprint,
+    validate_matrix,
+    validate_starring,
+)
+from albedo_tpu.utils import events, faults
+
+NOW = 1_700_000_000.0
+
+
+def clean_frame(n=6) -> pd.DataFrame:
+    return pd.DataFrame({
+        "user_id": np.arange(n, dtype=np.int64) % 3 + 100,
+        "repo_id": np.arange(n, dtype=np.int64) + 500,
+        "starred_at": NOW - np.arange(n, dtype=np.float64) * 1e4,
+        "starring": np.ones(n),
+    })
+
+
+def poisoned_frame() -> tuple[pd.DataFrame, dict[str, int]]:
+    """One frame seeding every violation class, plus the expected counts."""
+    s = clean_frame(6)
+    bad = pd.DataFrame({
+        # dangling ids (vocabulary = the clean frame's own ids)
+        "user_id": [999, 100, 100, 101, 102, 101, 102],
+        "repo_id": [500, 9999, 501, 502, 503, 504, 505],
+        "starred_at": [NOW, NOW, NOW, np.nan, -5.0, NOW + 10 * 86_400, NOW],
+        "starring": [1.0, 1.0, 0.0, -2.0, np.nan, 1.0, 1.0],
+    })
+    # (102, 505) duplicates a clean-frame pair with a newer VALID row — the
+    # earlier clean row is the flagged duplicate. (101, 504)'s newer
+    # duplicate is corrupt (future timestamp): it falls under its own rule
+    # and must NOT cost the pair its valid clean row.
+    frame = pd.concat([s, bad], ignore_index=True)
+    expected = {
+        "dangling_user": 1,
+        "dangling_repo": 1,
+        "duplicate_pair": 1,
+        "nonpositive_confidence": 3,
+        "timestamp_range": 3,
+    }
+    return frame, expected
+
+
+def _vocab(frame):
+    return dict(
+        user_vocab=np.array([100, 101, 102], np.int64),
+        repo_vocab=np.arange(500, 520, dtype=np.int64),
+        now=NOW,
+    )
+
+
+def test_clean_frame_passes_all_rules():
+    s = clean_frame()
+    out, report = validate_starring(s, policy="repair", **_vocab(s))
+    assert report.violations == {}
+    assert report.rows_in == report.rows_out == len(s)
+    pd.testing.assert_frame_equal(out, s)
+
+
+def test_every_rule_fires_and_counts():
+    frame, expected = poisoned_frame()
+    out, report = validate_starring(frame, policy="repair", **_vocab(frame))
+    for rule, count in expected.items():
+        assert report.violations[rule] == count, rule
+        assert events.data_violations.value(rule=rule) == count
+    # Survivors: no flagged row, and the duplicate kept the LAST occurrence.
+    assert len(out) == report.rows_out < report.rows_in
+    assert not (out["starring"] <= 0).any()
+    kept_505 = out[(out["user_id"] == 102) & (out["repo_id"] == 505)]
+    assert kept_505["starred_at"].tolist() == [NOW]  # the newer valid dup won
+    # The corrupt newer duplicate of (101, 504) was dropped under its own
+    # rule; the valid clean row for the pair survived.
+    assert len(out[(out["user_id"] == 101) & (out["repo_id"] == 504)]) == 1
+
+
+def test_dense_user_poison_flagged(monkeypatch):
+    monkeypatch.setenv("ALBEDO_DENSE_USER_MIN", "5")
+    monkeypatch.setenv("ALBEDO_DENSE_USER_FRAC", "0.8")
+    # Poison user 7 stars 9 of the 10 distinct repos (threshold = 8); user 8
+    # stars 2 and stays clean.
+    s = pd.DataFrame({
+        "user_id": [7] * 9 + [8, 8],
+        "repo_id": list(range(500, 509)) + [509, 500],
+        "starred_at": [NOW] * 11,
+        "starring": [1.0] * 11,
+    })
+    out, report = validate_starring(s, policy="repair", now=NOW)
+    assert report.violations == {"dense_user": 9}
+    assert out["user_id"].tolist() == [8, 8]
+
+
+def test_dense_user_counts_distinct_repos_not_raw_rows(monkeypatch):
+    monkeypatch.setenv("ALBEDO_DENSE_USER_MIN", "5")
+    monkeypatch.setenv("ALBEDO_DENSE_USER_FRAC", "0.8")
+    # User 7's crawl logged each of 4 distinct stars three times: 12 raw rows
+    # exceed the threshold (8 of the 10-repo catalog) but only 4 distinct
+    # repos do not — duplicated rows must not make a legitimate user poison.
+    s = pd.DataFrame({
+        "user_id": [7] * 12 + [8] * 5 + [9] * 5,
+        "repo_id": [500, 501, 502, 503] * 3 + list(range(500, 505))
+        + list(range(505, 510)),
+        "starred_at": NOW - np.arange(22, dtype=np.float64),
+        "starring": [1.0] * 22,
+    })
+    out, report = validate_starring(s, policy="repair", now=NOW)
+    assert "dense_user" not in report.violations
+    assert report.violations == {"duplicate_pair": 8}
+    assert sorted(out[out["user_id"] == 7]["repo_id"]) == [500, 501, 502, 503]
+    frame, expected = poisoned_frame()
+    with pytest.raises(DataValidationError) as ei:
+        validate_starring(frame, policy="strict", **_vocab(frame))
+    # ALL rules evaluated before raising — the report is complete, not
+    # first-failure-only.
+    for rule in expected:
+        assert rule in ei.value.report.violations, rule
+
+
+def test_off_policy_is_passthrough():
+    frame, _ = poisoned_frame()
+    out, report = validate_starring(frame, policy="off", **_vocab(frame))
+    assert out is frame
+    assert report.violations == {}
+    assert events.data_violations.value(rule="dangling_user") == 0
+
+
+def test_unknown_policy_rejected():
+    with pytest.raises(ValueError, match="unknown data policy"):
+        validate_starring(clean_frame(), policy="paranoid")
+
+
+def test_duplicate_pair_keeps_most_recent():
+    s = pd.DataFrame({
+        "user_id": [1, 1, 1],
+        "repo_id": [7, 7, 7],
+        "starred_at": [100.0, 300.0, 200.0],
+        "starring": [1.0, 1.0, 1.0],
+    }).sort_values("starred_at", kind="stable")
+    out, report = validate_starring(s, policy="repair", now=NOW)
+    assert report.violations["duplicate_pair"] == 2
+    assert out["starred_at"].tolist() == [300.0]
+
+
+def test_repair_writes_rule_tagged_quarantine_sidecar():
+    frame, _ = poisoned_frame()
+    _, report = validate_starring(
+        frame, policy="repair", quarantine_name="t-starring", **_vocab(frame)
+    )
+    assert report.quarantined_to == "t-starring.quarantine-1.csv"
+    side = pd.read_csv(artifact_path(report.quarantined_to))
+    assert len(side) == report.rows_in - report.rows_out
+    assert "rule" in side.columns and (side["rule"] != "").all()
+    # A row tripping several rules carries them comma-joined.
+    multi = side[side["rule"].str.contains(",")]
+    assert len(multi) >= 1
+    # A second pass numbers the next sidecar, never overwrites evidence.
+    _, r2 = validate_starring(
+        frame, policy="repair", quarantine_name="t-starring", **_vocab(frame)
+    )
+    assert r2.quarantined_to == "t-starring.quarantine-2.csv"
+
+
+def test_dense_user_threshold_floor_and_frac(monkeypatch):
+    monkeypatch.delenv("ALBEDO_DENSE_USER_FRAC", raising=False)
+    monkeypatch.delenv("ALBEDO_DENSE_USER_MIN", raising=False)
+    # Tiny catalogs stay under the floor: an enthusiast is not poison.
+    assert dense_user_threshold(10) == 20
+    # Large catalogs scale by fraction.
+    assert dense_user_threshold(1000) == 800
+    assert dense_user_threshold(1000, frac=0.5, floor=3) == 500
+
+
+def test_fault_site_fires_in_validation_pass():
+    faults.arm("data.validate", kind="error", at=1)
+    with pytest.raises(faults.FaultInjected):
+        validate_starring(clean_frame(), policy="repair", now=NOW)
+    # Policy off never reaches the site (the firewall is bypassed).
+    faults.arm("data.validate", kind="error", at=1)
+    validate_starring(clean_frame(), policy="off", now=NOW)
+
+
+def test_synthetic_tables_are_clean_through_validated_matrix():
+    tables = synthetic_tables(n_users=60, n_items=40, mean_stars=6, seed=3)
+    matrix, report = tables.validated_star_matrix(policy="repair", now=NOW)
+    assert report.violations == {}
+    # Byte-identical to the unvalidated build on clean data.
+    ref = tables.star_matrix()
+    np.testing.assert_array_equal(matrix.rows, ref.rows)
+    np.testing.assert_array_equal(matrix.cols, ref.cols)
+    np.testing.assert_array_equal(matrix.vals, ref.vals)
+
+
+def test_validated_matrix_drops_dangling_rows():
+    tables = synthetic_tables(n_users=60, n_items=40, mean_stars=6, seed=3)
+    dirty = tables.starring.copy()
+    dirty.loc[dirty.index[0], "user_id"] = -1  # not in user_info
+    tables = type(tables)(
+        user_info=tables.user_info, repo_info=tables.repo_info,
+        starring=dirty, relation=tables.relation,
+    )
+    matrix, report = tables.validated_star_matrix(policy="repair", now=NOW)
+    assert report.violations == {"dangling_user": 1}
+    assert -1 not in matrix.user_ids
+    with pytest.raises(DataValidationError):
+        tables.validated_star_matrix(policy="strict", now=NOW)
+
+
+def test_repair_matrix_matches_reference_build_on_dirty_data():
+    """The from_codes fast path must be byte-identical to from_interactions
+    over the surviving rows, even when repair dropped rows from several
+    rules (codes are a strict subset of the factorization's range)."""
+    tables = synthetic_tables(n_users=60, n_items=40, mean_stars=6, seed=7)
+    dirty = tables.starring.copy()
+    dirty.loc[dirty.index[0], "user_id"] = -1          # dangling_user
+    dirty.loc[dirty.index[1], "repo_id"] = -2          # dangling_repo
+    dirty.loc[dirty.index[2], "starring"] = 0.0        # nonpositive_confidence
+    dirty.loc[dirty.index[3], "starred_at"] = NOW * 9  # timestamp_range
+    dup = dirty.iloc[[4]].copy()
+    dup["starred_at"] = NOW  # duplicate_pair: valid and newer than any synthetic row
+    dirty = pd.concat([dirty, dup], ignore_index=True)
+    tables = type(tables)(
+        user_info=tables.user_info, repo_info=tables.repo_info,
+        starring=dirty, relation=tables.relation,
+    )
+    matrix, report = tables.validated_star_matrix(policy="repair", now=NOW)
+    for rule in ("dangling_user", "dangling_repo", "nonpositive_confidence",
+                 "timestamp_range", "duplicate_pair"):
+        assert report.violations[rule] >= 1, rule
+
+    from albedo_tpu.datasets.validate import validate_starring as _vs
+
+    s = dirty.sort_values("starred_at", kind="stable")
+    clean, _ = _vs(
+        s,
+        user_vocab=tables.user_info["user_id"].to_numpy(np.int64),
+        repo_vocab=tables.repo_info["repo_id"].to_numpy(np.int64),
+        now=NOW, policy="repair",
+    )
+    ref = StarMatrix.from_interactions(
+        raw_users=clean["user_id"].to_numpy(np.int64),
+        raw_items=clean["repo_id"].to_numpy(np.int64),
+    )
+    np.testing.assert_array_equal(matrix.user_ids, ref.user_ids)
+    np.testing.assert_array_equal(matrix.item_ids, ref.item_ids)
+    np.testing.assert_array_equal(matrix.rows, ref.rows)
+    np.testing.assert_array_equal(matrix.cols, ref.cols)
+    np.testing.assert_array_equal(matrix.vals, ref.vals)
+
+
+# --- matrix-level invariants --------------------------------------------------
+
+
+def _matrix(rows, cols, vals, n_users=4, n_items=3) -> StarMatrix:
+    return StarMatrix(
+        user_ids=np.arange(n_users, dtype=np.int64),
+        item_ids=np.arange(n_items, dtype=np.int64),
+        rows=np.asarray(rows, np.int32),
+        cols=np.asarray(cols, np.int32),
+        vals=np.asarray(vals, np.float32),
+    )
+
+
+def test_matrix_invariants_clean():
+    report = validate_matrix(_matrix([0, 1], [0, 1], [1.0, 2.0]), policy="strict")
+    assert report.violations == {}
+
+
+def test_matrix_invariants_flag_oob_and_degenerate():
+    m = _matrix([0, 1, 5], [0, 1, 0], [1.0, 0.0, 1.0])
+    report = validate_matrix(m, policy="repair")
+    assert report.violations["index_out_of_range"] == 1
+    assert report.violations["nonpositive_confidence"] == 1
+    # user 1's only entry is zero-confidence: a degenerate all-zero row.
+    assert report.violations["all_zero_row"] == 1
+    assert events.data_violations.value(rule="all_zero_row") == 1
+    with pytest.raises(DataValidationError):
+        validate_matrix(m, policy="strict")
+
+
+def test_matrix_fingerprint_tracks_content():
+    a = _matrix([0, 1], [0, 1], [1.0, 2.0])
+    b = _matrix([0, 1], [0, 1], [1.0, 2.0])
+    c = _matrix([0, 1], [0, 1], [1.0, 3.0])
+    assert matrix_fingerprint(a) == matrix_fingerprint(b)
+    assert matrix_fingerprint(a) != matrix_fingerprint(c)

@@ -156,3 +156,62 @@ def test_auc_weighted_ties(rng):
     num += 0.5 * (wp[:, None] * wn[None, :] * (sp[:, None] == sn[None, :])).sum()
     want = num / (wp.sum() * wn.sum())
     assert area_under_roc(labels, scores, weights) == pytest.approx(want, abs=1e-9)
+
+
+# --- edge cases the canary publish gate makes load-bearing (PR 5) -------------
+
+
+def test_ndcg_empty_ground_truth_rows_score_zero():
+    """A user with no held-out positives scores 0 and still counts toward the
+    mean (MLlib semantics) — all-empty actuals give exactly 0.0, not NaN."""
+    pred = np.array([[0, 1, 2], [3, 4, 5]], dtype=np.int32)
+    empty = np.full((2, 3), -1, dtype=np.int32)
+    assert ndcg_at_k(pred, empty, k=3) == 0.0
+    assert mean_average_precision(pred, empty, k=3) == 0.0
+    # Mixed: one empty row halves the mean of the other.
+    actual = np.array([[0, 1, 2], [-1, -1, -1]], dtype=np.int32)
+    full = ndcg_at_k(pred[:1], actual[:1], k=3)
+    assert ndcg_at_k(pred, actual, k=3) == pytest.approx(full / 2.0, abs=1e-7)
+
+
+def test_ndcg_k_larger_than_candidate_list():
+    """k beyond both list widths must match the hand-computed reference, not
+    index out of range or dilute the ideal DCG."""
+    pred = np.array([[7, 3]], dtype=np.int32)
+    actual = np.array([[3]], dtype=np.int32)
+    got = ndcg_at_k(pred, actual, k=30)
+    want = _mllib_ndcg([7, 3], [3], 30)
+    assert got == pytest.approx(want, abs=1e-6)
+    # f32 accumulation inside the evaluator: compare at f32 resolution.
+    assert precision_at_k(pred, actual, k=30) == pytest.approx(1 / 30, abs=1e-6)
+
+
+def test_evaluator_no_common_users_raises():
+    p = UserItems(np.array([1], np.int32), np.array([[0]], np.int32))
+    a = UserItems(np.array([2], np.int32), np.array([[0]], np.int32))
+    with pytest.raises(ValueError, match="no users in common"):
+        RankingEvaluator(k=5).evaluate(p, a)
+
+
+def test_tied_scores_deterministic_stable():
+    """Ties break by input order (stable sort), identically across runs."""
+    users = np.array([1] * 4)
+    items = np.array([10, 11, 12, 13], dtype=np.int32)
+    score = np.array([0.5, 0.9, 0.5, 0.5])
+    runs = [
+        user_items_from_pairs(users, items, order_key=score, k=4).items.tolist()
+        for _ in range(3)
+    ]
+    assert runs[0] == runs[1] == runs[2]
+    # Best score first, then the tied block in input order.
+    assert runs[0][0] == [11, 10, 12, 13]
+
+
+def test_nan_scores_rank_last_deterministically():
+    """A diverged model's NaN scores must depress the ranking, not shuffle it:
+    NaN-keyed items land after every real score, stably."""
+    users = np.array([1] * 4)
+    items = np.array([10, 11, 12, 13], dtype=np.int32)
+    score = np.array([np.nan, 0.2, np.nan, 0.7])
+    ui = user_items_from_pairs(users, items, order_key=score, k=4)
+    assert ui.items[0].tolist() == [13, 11, 10, 12]

@@ -86,5 +86,6 @@ def test_known_sites_are_present():
         "pipeline.stage", "pipeline.stage.<name>",
         "serving.source.<name>", "serving.rank",
         "serving.breaker.<name>", "reload.load", "reload.validate",
+        "data.validate", "train.watchdog", "pipeline.canary",
     ):
         assert site in code, f"expected fault site {site!r} not found in code"

@@ -459,3 +459,215 @@ def test_corrupt_candidate_mid_serve_drill_over_http(artifacts):
             assert got == _expected(model_b, matrix, uid, K)
             status, ready = _get(handle, "/healthz/ready")
             assert status == 200 and ready["generation"] == 2
+
+
+# --- the publish-quality stamp gate (PR 5) ------------------------------------
+
+
+def _stamp(path, score=0.5, passed=True, forced=False):
+    from albedo_tpu.datasets.artifacts import write_meta
+
+    return write_meta(path, {
+        "canary": {"metric": "ndcg@30", "score": score, "passed": passed,
+                   "forced": forced},
+    })
+
+
+def test_unstamped_artifact_rejected_under_require_stamp(artifacts):
+    from albedo_tpu.utils import events
+
+    _, matrix, _, model_b = artifacts
+    with _service(artifacts) as svc:
+        mgr = HotSwapManager(svc, probe_users=4, probe_k=K, require_stamp=True)
+        path = _write_model("candidate-alsModel.pkl", model_b)
+        report = mgr.request_reload(path)
+        assert report["outcome"] == "rejected" and report["gate"] == "stamp"
+        assert "unstamped" in report["detail"]
+        assert svc.generation.number == 1
+        assert events.publish_rejected.value(gate="stamp") == 1
+        # Rejected candidate quarantined under the shared convention.
+        assert not path.exists()
+
+
+def test_unstamped_artifact_admitted_by_default(artifacts):
+    _, _, _, model_b = artifacts
+    with _service(artifacts) as svc:
+        mgr = HotSwapManager(svc, probe_users=4, probe_k=K)
+        path = _write_model("candidate-alsModel.pkl", model_b)
+        report = mgr.request_reload(path)
+        assert report["outcome"] == "promoted"
+        assert report["gates"]["stamp"] == "missing (unverified)"
+
+
+def test_stamped_artifact_promotes_and_records_score(artifacts):
+    _, _, _, model_b = artifacts
+    with _service(artifacts) as svc:
+        mgr = HotSwapManager(svc, probe_users=4, probe_k=K, require_stamp=True)
+        path = _write_model("candidate-alsModel.pkl", model_b)
+        _stamp(path, score=0.42)
+        report = mgr.request_reload(path)
+        assert report["outcome"] == "promoted"
+        assert report["gates"]["stamp"] == {"canary_score": 0.42}
+
+
+def test_stamp_recording_failed_canary_rejects(artifacts):
+    _, _, _, model_b = artifacts
+    with _service(artifacts) as svc:
+        mgr = HotSwapManager(svc, probe_users=4, probe_k=K)
+        path = _write_model("candidate-alsModel.pkl", model_b)
+        _stamp(path, score=0.1, passed=False)
+        report = mgr.request_reload(path)
+        assert report["outcome"] == "rejected" and report["gate"] == "stamp"
+        assert "failed canary" in report["detail"]
+
+
+def test_forced_stamp_admitted_but_visible(artifacts):
+    _, _, _, model_b = artifacts
+    with _service(artifacts) as svc:
+        mgr = HotSwapManager(svc, probe_users=4, probe_k=K, require_stamp=True)
+        path = _write_model("candidate-alsModel.pkl", model_b)
+        _stamp(path, score=0.1, passed=False, forced=True)
+        report = mgr.request_reload(path)
+        assert report["outcome"] == "promoted"
+        assert report["gates"]["stamp"] == {"canary_score": 0.1, "forced": True}
+
+
+def test_stamp_for_different_bytes_rejects(artifacts):
+    """A stamp issued against other bytes must not vouch for this artifact —
+    even when the .sha256 manifest itself is valid."""
+    _, _, model_a, model_b = artifacts
+    with _service(artifacts) as svc:
+        mgr = HotSwapManager(svc, probe_users=4, probe_k=K)
+        path = _write_model("candidate-alsModel.pkl", model_b)
+        _stamp(path, score=0.9)  # stamp binds to model_b's bytes
+        # The artifact is then replaced (re-manifested, so gate 1 passes).
+        _write_model("candidate-alsModel.pkl", model_a)
+        report = mgr.request_reload(path)
+        assert report["outcome"] == "rejected" and report["gate"] == "stamp"
+        assert "different artifact bytes" in report["detail"]
+
+
+def test_stamp_regression_vs_promoted_generation_rejects(artifacts):
+    from albedo_tpu.utils import events
+
+    _, _, model_a, model_b = artifacts
+    with _service(artifacts) as svc:
+        mgr = HotSwapManager(svc, probe_users=4, probe_k=K, canary_tolerance=0.10)
+        good = _write_model("good-alsModel.pkl", model_b)
+        _stamp(good, score=0.50)
+        assert mgr.request_reload(good)["outcome"] == "promoted"
+
+        # A later candidate scoring >10% below the PROMOTED generation's
+        # stamp is refused before the unpickle.
+        worse = _write_model("worse-alsModel.pkl", model_a)
+        _stamp(worse, score=0.40)
+        report = mgr.request_reload(worse)
+        assert report["outcome"] == "rejected" and report["gate"] == "stamp"
+        assert "regressed" in report["detail"]
+        assert svc.generation.number == 2  # the good generation still serves
+        assert events.publish_rejected.value(gate="stamp") == 1
+
+        # Within tolerance promotes and advances the baseline.
+        ok = _write_model("ok-alsModel.pkl", model_a)
+        _stamp(ok, score=0.47)
+        assert mgr.request_reload(ok)["outcome"] == "promoted"
+        assert mgr._promoted_canary_score == 0.47
+
+
+def test_rollback_restores_incumbent_stamp_baseline(artifacts):
+    """An error-rate rollback must also roll the stamp gate's regression
+    baseline back to the re-promoted incumbent's own score — otherwise the
+    rolled-back candidate's (higher) score keeps gating out candidates
+    better than what is actually serving, blocking recovery."""
+    _, _, model_a, model_b = artifacts
+    with _service(artifacts) as svc:
+        mgr = HotSwapManager(
+            svc, probe_users=4, probe_k=K, canary_tolerance=0.10,
+            error_rate_threshold=0.5, error_rate_min_requests=10,
+        )
+        good = _write_model("g-alsModel.pkl", model_b)
+        _stamp(good, score=0.50)
+        assert mgr.request_reload(good)["outcome"] == "promoted"
+
+        better = _write_model("b-alsModel.pkl", model_a)
+        _stamp(better, score=0.60)
+        assert mgr.request_reload(better)["outcome"] == "promoted"
+
+        # Post-swap 5xx storm rolls back to the 0.50 generation.
+        for _ in range(12):
+            svc.metrics.requests.inc(route="recommend", status="500")
+        assert mgr.check_error_rate()["verdict"] == "regressed"
+        assert mgr._promoted_canary_score == 0.50
+
+        # A candidate better than what is SERVING (0.52 > 0.50) promotes —
+        # under the rolled-back 0.60 baseline it would have been refused.
+        recovery = _write_model("r-alsModel.pkl", model_b)
+        _stamp(recovery, score=0.52)
+        assert mgr.request_reload(recovery)["outcome"] == "promoted"
+        assert mgr._promoted_canary_score == 0.52
+
+
+def test_stamp_binding_survives_missing_manifest(artifacts):
+    """Losing the .sha256 sidecar must not let a stamp vouch for different
+    bytes — the gate falls back to hashing the artifact itself."""
+    from albedo_tpu.datasets.artifacts import manifest_path
+
+    _, _, model_a, model_b = artifacts
+    with _service(artifacts) as svc:
+        mgr = HotSwapManager(svc, probe_users=4, probe_k=K)
+        path = _write_model("candidate-alsModel.pkl", model_b)
+        _stamp(path, score=0.9)  # binds to model_b's bytes
+        # Replace the bytes and strip the manifest: gate 1 admits it as
+        # "missing (unverified)", so only the stamp's own hash can catch it.
+        _write_model("candidate-alsModel.pkl", model_a)
+        manifest_path(path).unlink()
+        report = mgr.request_reload(path)
+        assert report["outcome"] == "rejected" and report["gate"] == "stamp"
+        assert "different artifact bytes" in report["detail"]
+
+
+@pytest.mark.chaos
+def test_stamp_gate_drill_over_http(artifacts):
+    """Acceptance (PR 5): a live server keeps serving the last-known-good
+    generation while the reload stamp gate rejects an UNSTAMPED candidate
+    (require_stamp) and then a REGRESSED-stamp candidate — both visible on
+    /metrics as albedo_publish_rejected_total{gate="stamp"}."""
+    tables, matrix, model_a, model_b = artifacts
+    with _service(artifacts) as svc:
+        mgr = HotSwapManager(svc, probe_users=4, probe_k=K, require_stamp=True)
+        with serve(svc, port=0) as handle:
+            # Promote the stamped last-known-good.
+            good = _write_model("lkg-alsModel.pkl", model_b)
+            _stamp(good, score=0.50)
+            status, report = _post(handle, "/admin/reload?artifact=" + good.name)
+            assert status == 200 and report["outcome"] == "promoted", report
+            uid = int(matrix.user_ids[0])
+            status, before = _get(handle, f"/recommend/{uid}?k={K}&exclude_seen=0")
+            assert status == 200 and before["generation"] == 2
+
+            # An unstamped candidate never reaches the swap path.
+            unstamped = _write_model("sneaky-alsModel.pkl", model_a)
+            status, report = _post(handle, "/admin/reload?artifact=" + unstamped.name)
+            assert status == 409
+            assert report["outcome"] == "rejected" and report["gate"] == "stamp"
+
+            # Neither does a stamped-but-regressed one.
+            worse = _write_model("regressed-alsModel.pkl", model_a)
+            _stamp(worse, score=0.30)
+            status, report = _post(handle, "/admin/reload?artifact=" + worse.name)
+            assert status == 409
+            assert report["outcome"] == "rejected" and report["gate"] == "stamp"
+
+            # The incumbent generation served identically throughout.
+            status, after = _get(handle, f"/recommend/{uid}?k={K}&exclude_seen=0")
+            assert status == 200 and after["generation"] == 2
+            assert after["items"] == before["items"]
+
+            host, port = handle.server_address[:2]
+            with urllib.request.urlopen(
+                f"http://{host}:{port}/metrics", timeout=30
+            ) as r:
+                text = r.read().decode()
+            assert 'albedo_publish_rejected_total{gate="stamp"} 2' in text
+            assert 'albedo_reload_rejected_total{gate="stamp"} 2' in text
+            assert "albedo_model_generation 2" in text

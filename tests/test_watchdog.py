@@ -1,0 +1,188 @@
+"""The training divergence watchdog: on-device health stats, tripwires,
+damped remediation, the ``train.watchdog`` chaos site, and the shared
+quarantine helper (``utils/watchdog.py``, ``utils/quarantine.py``)."""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from albedo_tpu.datasets.synthetic import synthetic_stars  # noqa: E402
+from albedo_tpu.models.als import ImplicitALS  # noqa: E402
+from albedo_tpu.utils import events, faults  # noqa: E402
+from albedo_tpu.utils.checkpoint import StepCheckpointer, checkpointed_als_fit  # noqa: E402
+from albedo_tpu.utils.quarantine import next_marked_path, quarantine_rename  # noqa: E402
+from albedo_tpu.utils.watchdog import (  # noqa: E402
+    DivergenceWatchdog,
+    TrainingDiverged,
+    check_lr_loss,
+    damped,
+    factor_health,
+    guarded_fit,
+    health_dict,
+)
+
+
+def test_factor_health_device_stats():
+    uf = np.array([[1.0, 2.0], [3.0, 4.0]], np.float32)
+    vf = np.array([[0.5, np.nan]], np.float32)
+    h = health_dict(factor_health(uf, vf))
+    assert h["nonfinite"] == 1
+    assert h["max_abs"] == pytest.approx(4.0)
+    # RMS = max over tables, NaN treated as 0 in the finite view.
+    assert h["rms"] == pytest.approx(float(np.sqrt(np.mean(np.square(uf)))))
+
+
+def test_watchdog_trips_nonfinite_norm_trajectory():
+    wd = DivergenceWatchdog(max_rms=10.0, max_growth=5.0)
+    ok = np.ones((4, 4), np.float32)
+    assert wd.check(1, ok, ok) == []
+
+    bad = ok.copy(); bad[0, 0] = np.inf
+    assert wd.check(2, bad, ok) == ["nonfinite"]
+    assert events.watchdog_trips.value(kind="nonfinite") == 1
+
+    assert "norm" in wd.check(3, np.full((4, 4), 100.0, np.float32), ok)
+    # 1.0 -> 8.0 is an >5x jump vs the last HEALTHY baseline (step 1).
+    assert wd.check(4, np.full((4, 4), 8.0, np.float32), ok) == ["trajectory"]
+    assert len(wd.trips) == 3 and not any(t["remediated"] for t in wd.trips)
+
+
+def test_trajectory_baseline_only_advances_on_healthy_checks():
+    wd = DivergenceWatchdog(max_rms=1e6, max_growth=3.0)
+    one = np.ones((2, 2), np.float32)
+    assert wd.check(1, one, one) == []
+    # A 4x explosion trips; a SECOND check at the same level must still trip
+    # (the tripped check must not have ratcheted the baseline up to 4.0).
+    assert wd.check(2, 4 * one, one) == ["trajectory"]
+    assert wd.check(3, 4 * one, one) == ["trajectory"]
+
+
+def test_fault_site_scribbles_nan_into_check():
+    wd = DivergenceWatchdog()
+    ok = np.ones((3, 3), np.float32)
+    faults.arm("train.watchdog", kind="error", at=1)
+    assert wd.check(1, ok, ok) == ["nonfinite"]
+    assert wd.trips[-1]["nonfinite"] == 1
+    # The caller's array is untouched — the scribble happens on a copy.
+    assert np.isfinite(ok).all()
+    assert wd.check(2, ok, ok) == []  # fault exhausted; healthy again
+
+
+def test_damped_estimator_stabilizers():
+    als = ImplicitALS(rank=4, reg_param=0.5, gather_dtype="bfloat16")
+    d = damped(als)
+    assert d.gather_dtype is None
+    assert d.reg_param == pytest.approx(5.0)
+    assert d.rank == als.rank
+
+
+@dataclasses.dataclass
+class _FakeALS:
+    """Estimator double for guarded_fit: diverges for the first ``sick``
+    fits, then recovers (remediation replaces the instance via
+    ``dataclasses.replace``, so call counting lives in a shared list)."""
+
+    reg_param: float = 0.5
+    gather_dtype: str | None = "bfloat16"
+    max_iter: int = 4
+    sick: int = 1
+    calls: list = dataclasses.field(default_factory=list)
+
+    def fit(self, matrix):
+        self.calls.append(self.reg_param)
+        f = np.ones((3, 2), np.float32)
+        if len(self.calls) <= self.sick:
+            f = f * np.nan
+        return dataclasses.replace(_Model(), user_factors=f, item_factors=f)
+
+
+@dataclasses.dataclass
+class _Model:
+    user_factors: np.ndarray = None
+    item_factors: np.ndarray = None
+
+
+def test_guarded_fit_remediates_once():
+    calls = []
+    als = _FakeALS(sick=1, calls=calls)
+    model, trips = guarded_fit(als, matrix=None)
+    assert np.isfinite(model.user_factors).all()
+    # Second call came from the damped estimator: 10x regularization.
+    assert calls == [0.5, 5.0]
+    assert len(trips) == 1 and trips[0]["remediated"] is True
+    assert trips[0]["kinds"] == ["nonfinite"]
+
+
+def test_guarded_fit_raises_when_remediation_fails():
+    als = _FakeALS(sick=2, calls=[])
+    with pytest.raises(TrainingDiverged):
+        guarded_fit(als, matrix=None)
+    assert events.watchdog_trips.value(kind="nonfinite") == 2
+
+
+def test_checkpointed_fit_remediates_tripped_chunk(tmp_path):
+    """The mid-fit NaN drill, in process: a chunk-boundary check trips (the
+    fault site scribbles NaN), the chunk re-runs damped from the previous
+    checkpoint, the fit completes, and the journal records the remediated
+    trip."""
+    m = synthetic_stars(n_users=120, n_items=70, mean_stars=8, seed=6)
+    als = ImplicitALS(rank=8, max_iter=4, seed=4)
+    wd = DivergenceWatchdog()
+    faults.arm("train.watchdog", kind="error", at=2)  # trips the 2nd check
+    model = checkpointed_als_fit(
+        als, m, tmp_path / "wd", every=2, watchdog=wd
+    )
+    assert np.isfinite(model.user_factors).all()
+    assert len(wd.trips) == 1 and wd.trips[0]["remediated"] is True
+    journal = StepCheckpointer(tmp_path / "wd").read_journal()
+    assert journal["status"] == "complete"
+    assert journal["watchdog"][0]["kinds"] == ["nonfinite"]
+    assert journal["watchdog"][0]["remediated"] is True
+    assert events.watchdog_trips.value(kind="nonfinite") == 1
+
+
+def test_checkpointed_fit_gives_up_after_failed_remediation(tmp_path):
+    m = synthetic_stars(n_users=80, n_items=50, mean_stars=6, seed=6)
+    als = ImplicitALS(rank=8, max_iter=2, seed=4)
+    wd = DivergenceWatchdog()
+    faults.arm("train.watchdog", kind="error", at=1, times=2)  # both checks
+    with pytest.raises(TrainingDiverged):
+        checkpointed_als_fit(als, m, tmp_path / "div", every=2, watchdog=wd)
+    journal = StepCheckpointer(tmp_path / "div").read_journal()
+    assert journal["status"] == "diverged"
+    assert any(not t["remediated"] for t in journal["watchdog"])
+
+
+def test_check_lr_loss():
+    assert check_lr_loss(0.31)
+    assert not check_lr_loss(float("nan"))
+    assert not check_lr_loss(float("inf"))
+    assert events.watchdog_trips.value(kind="lr") == 2
+
+
+# --- the shared quarantine convention -----------------------------------------
+
+
+def test_next_marked_path_numbers_from_one(tmp_path):
+    p = tmp_path / "model.pkl"
+    assert next_marked_path(p).name == "model.pkl.corrupt-1"
+    (tmp_path / "model.pkl.corrupt-1").touch()
+    assert next_marked_path(p).name == "model.pkl.corrupt-2"
+    assert next_marked_path(p, ".quarantine-", ".csv").name == "model.pkl.quarantine-1.csv"
+
+
+def test_quarantine_rename_moves_sidecars_along(tmp_path):
+    p = tmp_path / "model.pkl"
+    p.write_bytes(b"data")
+    (tmp_path / "model.pkl.sha256").write_text("{}")
+    (tmp_path / "model.pkl.meta.json").write_text("{}")
+    dest = quarantine_rename(p, reason="test")
+    assert dest.name == "model.pkl.corrupt-1"
+    assert not p.exists()
+    # No stale sidecar may vouch for the slot's next occupant.
+    assert not (tmp_path / "model.pkl.sha256").exists()
+    assert (tmp_path / "model.pkl.corrupt-1.sha256").exists()
+    assert (tmp_path / "model.pkl.corrupt-1.meta.json").exists()
