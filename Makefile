@@ -8,10 +8,10 @@ ARGS ?=
 JOBS = popularity curation content train_als cv_als build_user_profile \
        build_repo_profile train_word2vec train_lr cv_lr item_cf user_cf \
        tfidf_content ranking_mf collect_data drop_data sync_index serve play \
-       run_pipeline datacheck
+       run_pipeline datacheck run_stream
 
 .PHONY: $(JOBS) test test-all bench serve-bench datacheck-bench chaos \
-        chaos-serve dryrun
+        chaos-serve chaos-stream stream stream-bench dryrun
 
 $(JOBS):
 	$(PY) -m albedo_tpu.cli $@ $(ARGS)
@@ -48,6 +48,22 @@ chaos:
 # overload shedding through real HTTP.
 chaos-serve:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m chaos -k "serving or reload or breaker"
+
+# Streaming chaos: kill mid-fold-in through the real CLI — the served
+# generation must never be a half-applied delta.
+chaos-stream:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_chaos_stream.py -q -m chaos
+
+# The minutes-stale loop: validated delta ingest -> fold-in -> drift check
+# -> stamped hot-swap publish (see README "Streaming runbook").
+stream:
+	$(PY) -m albedo_tpu.cli run_stream $(ARGS)
+
+# Streaming scenario: fold-in latency per touched-user batch, sustained
+# deltas/sec, and the fold-in-vs-full-refit wall-clock ratio (interleaved
+# trials, medians — per the bench-box throttling policy).
+stream-bench:
+	$(PY) bench.py foldin
 
 dryrun:
 	$(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('ok')"

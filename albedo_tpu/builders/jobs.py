@@ -35,6 +35,16 @@ from albedo_tpu.builders.profiles import VINTA_USER_ID, build_repo_profile, buil
 
 TOP_K = 30
 
+# The flagship ALS artifact's hyperparameter defaults — re-exported from
+# the estimator itself (ONE definition), shared with the streaming fold-in
+# engine, which must solve with the SAME regularization/alpha the base
+# artifact was trained with (a mismatch would bias every folded row
+# relative to the refit path).
+from albedo_tpu.models.als import ImplicitALS as _ImplicitALS  # noqa: E402
+
+ALS_REG = _ImplicitALS.reg_param
+ALS_ALPHA = _ImplicitALS.alpha
+
 
 class JobContext:
     """Shared lazily-built artifacts for one CLI invocation."""
@@ -198,7 +208,7 @@ class JobContext:
             return (1000, 290_000)
         return (1, 10**9)
 
-    def als_key(self, rank=50, reg=0.5, alpha=40.0, iters=26) -> str:
+    def als_key(self, rank=50, reg=ALS_REG, alpha=ALS_ALPHA, iters=26) -> str:
         """The flagship ALS artifact's base key (hyperparams baked into the
         name, solver-tagged when not the parity default) — one definition
         shared by training, the canary publish gate, and the serve watcher."""
@@ -213,7 +223,7 @@ class JobContext:
     def als_artifact_name(self, **kw) -> str:
         return self.artifact_name(self.als_key(**kw) + ".pkl")
 
-    def als_model(self, rank=50, reg=0.5, alpha=40.0, iters=26):
+    def als_model(self, rank=50, reg=ALS_REG, alpha=ALS_ALPHA, iters=26):
         from albedo_tpu.models.als import ImplicitALS
 
         key = self.als_key(rank=rank, reg=reg, alpha=alpha, iters=iters)

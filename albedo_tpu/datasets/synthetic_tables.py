@@ -196,3 +196,107 @@ def synthetic_tables(
     return RawTables(
         user_info=user_info, repo_info=repo_info, starring=starring, relation=relation
     ).conformed()
+
+
+def synthetic_delta_stream(
+    matrix: StarMatrix,
+    n_batches: int = 5,
+    batch_size: int = 200,
+    seed: int = 7,
+    start_at: float | None = None,
+    batch_interval_s: float = 3600.0,
+    frac_unstar: float = 0.10,
+    frac_new_user: float = 0.05,
+    frac_new_repo: float = 0.05,
+) -> list[pd.DataFrame]:
+    """Deterministic star-delta batches for streaming tests and bench.
+
+    Each batch is a frame in the delta schema (``streaming.deltas.
+    DELTA_COLUMNS``: user_id, repo_id, starred_at, starring, op) with the
+    crawl tail's statistical shape:
+
+    - **new stars** (the bulk): users sampled by Zipf over their activity
+      rank, repos by Zipf over popularity rank — the power-law the base
+      matrix already has, so fresh stars concentrate where real ones do;
+    - **un-stars** (``frac_unstar``): tombstones of existing nonzeros;
+    - **new users** (``frac_new_user``): ids outside the user vocabulary
+      starring popular repos (vocabulary growth — the fold-out queue's
+      diet);
+    - **new repos** (``frac_new_repo``): stars of ids outside the item
+      vocabulary by existing users.
+
+    Timestamps increase within and across batches from ``start_at``
+    (default: just past the epoch the synthetic tables use), stepping
+    ``batch_interval_s`` per batch — so replays are deterministic and a
+    stream clock derived from the batch maxima is monotone.
+    """
+    rng = np.random.default_rng(seed)
+    n_users, n_items = matrix.n_users, matrix.n_items
+    if start_at is None:
+        start_at = 1.51e9 + 60.0  # just past the tables' crawl epoch
+
+    # Power-law sampling weights anchored to observed activity/popularity:
+    # rank by count, weight ~ 1/rank (Zipf over the behavioral ranking).
+    def zipf_weights(counts: np.ndarray) -> np.ndarray:
+        order = np.argsort(-counts, kind="stable")
+        ranks = np.empty_like(order)
+        ranks[order] = np.arange(1, counts.shape[0] + 1)
+        w = 1.0 / ranks
+        return w / w.sum()
+
+    user_w = zipf_weights(matrix.user_counts())
+    item_w = zipf_weights(matrix.item_counts())
+    next_new_user = int(matrix.user_ids.max()) + 1 if n_users else 1
+    next_new_repo = int(matrix.item_ids.max()) + 1 if n_items else 1
+
+    batches: list[pd.DataFrame] = []
+    for b in range(n_batches):
+        t0 = start_at + b * batch_interval_s
+        n_un = int(round(batch_size * frac_unstar))
+        n_nu = int(round(batch_size * frac_new_user))
+        n_nr = int(round(batch_size * frac_new_repo))
+        n_star = max(0, batch_size - n_un - n_nu - n_nr)
+
+        uid: list[int] = []
+        rid: list[int] = []
+        op: list[str] = []
+        # New stars: known user x known repo, power-law both sides.
+        du = rng.choice(n_users, size=n_star, p=user_w)
+        di = rng.choice(n_items, size=n_star, p=item_w)
+        uid += [int(matrix.user_ids[u]) for u in du]
+        rid += [int(matrix.item_ids[i]) for i in di]
+        op += ["star"] * n_star
+        # Un-stars: tombstones of existing nonzeros.
+        if n_un and matrix.nnz:
+            pick = rng.choice(matrix.nnz, size=n_un, replace=False)
+            uid += [int(matrix.user_ids[matrix.rows[p]]) for p in pick]
+            rid += [int(matrix.item_ids[matrix.cols[p]]) for p in pick]
+            op += ["unstar"] * n_un
+        # New users starring popular repos (vocabulary growth).
+        for _ in range(n_nu):
+            uid.append(next_new_user)
+            next_new_user += 1
+            rid.append(int(matrix.item_ids[rng.choice(n_items, p=item_w)]))
+            op.append("star")
+        # New repos starred by active users (vocabulary growth).
+        for _ in range(n_nr):
+            uid.append(int(matrix.user_ids[rng.choice(n_users, p=user_w)]))
+            rid.append(next_new_repo)
+            next_new_repo += 1
+            op.append("star")
+
+        n = len(uid)
+        # Random arrival times inside the batch window; sorting the frame by
+        # them interleaves the categories the way a real crawl tail would.
+        ts = t0 + rng.random(n) * (batch_interval_s * 0.9)
+        frame = pd.DataFrame(
+            {
+                "user_id": np.asarray(uid, dtype=np.int64),
+                "repo_id": np.asarray(rid, dtype=np.int64),
+                "starred_at": ts,
+                "starring": np.ones(n, dtype=np.float64),
+                "op": op,
+            }
+        )
+        batches.append(frame.sort_values("starred_at", kind="stable").reset_index(drop=True))
+    return batches

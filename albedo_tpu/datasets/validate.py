@@ -35,7 +35,11 @@ rule                        flags
 ``nonpositive_confidence``  ``starring`` <= 0 or NaN (implicit-feedback
                             confidences must be positive)
 ``timestamp_range``         ``starred_at`` NaN, <= 0, or in the future
-                            (beyond ``now`` + 1 day of clock skew)
+                            (beyond ``now`` + 1 day of clock skew; ``now``
+                            is an EXPLICIT parameter — pass it when
+                            replaying journaled or streamed rows so the
+                            verdicts are deterministic; ``None`` reads the
+                            wall clock once per pass)
 ``dense_user``              "poison" users starring a suspiciously large
                             fraction of the catalog — DISTINCT repos per user
                             (duplicated crawl rows don't inflate the count)
@@ -68,6 +72,7 @@ import hashlib
 import logging
 import math
 import os
+import time
 from typing import TYPE_CHECKING
 
 import numpy as np
@@ -188,7 +193,7 @@ def _rule_masks(
     fact: Factorization,
     user_dangling: np.ndarray | None,
     repo_dangling: np.ndarray | None,
-    now: float | None,
+    now: float,
 ) -> list[tuple[str, np.ndarray]]:
     """(rule, bad-row mask) per catalog rule, in documented order. All masks
     derive from the shared factorization — no additional full-column sort."""
@@ -218,8 +223,7 @@ def _rule_masks(
     if "starred_at" in s.columns:
         ts = s["starred_at"].to_numpy(np.float64)
         bad_ts = ~(ts > 0)  # NaN or non-positive epoch
-        if now is not None:
-            bad_ts |= ts > float(now) + FUTURE_SLACK_S
+        bad_ts |= ts > float(now) + FUTURE_SLACK_S
 
     # Duplicate (user, repo) pairs via a single int64 pair key over the
     # codes — a hash-table duplicated() pass instead of a two-column sort.
@@ -240,19 +244,26 @@ def _rule_masks(
     if "starred_at" in s.columns:
         masks.append(("timestamp_range", bad_ts))
 
-    # Poison users: per-user DISTINCT-repo counts vs the observed catalog
-    # size, over rows no other rule already condemned — duplicated crawl
-    # rows must not inflate a legitimate user toward the threshold.
+    # Poison users: per-user DISTINCT-repo counts vs the catalog size, over
+    # rows no other rule already condemned — duplicated crawl rows must not
+    # inflate a legitimate user toward the threshold. When an explicit repo
+    # vocabulary was given it IS the catalog; the observed distinct count
+    # only approximates it on full-table ingest and collapses to the floor
+    # on small streamed batches (a bursty-but-legitimate user's catch-up
+    # stars must not read as poison against a 40-row frame).
     valid_pair = ~invalid & ~dup
     counts = np.bincount(
         user_codes[valid_pair], minlength=fact.user_vocab.shape[0]
     )
-    n_distinct_repos = int(
-        (np.bincount(
-            repo_codes[valid_pair], minlength=fact.repo_vocab.shape[0]
-        ) > 0).sum()
-    )
-    threshold = dense_user_threshold(n_distinct_repos)
+    if repo_dangling is not None:
+        catalog = int(fact.repo_vocab.shape[0])
+    else:
+        catalog = int(
+            (np.bincount(
+                repo_codes[valid_pair], minlength=fact.repo_vocab.shape[0]
+            ) > 0).sum()
+        )
+    threshold = dense_user_threshold(catalog)
     dense = counts >= threshold
     if dense.any():
         valid_u = user_codes >= 0
@@ -315,6 +326,13 @@ def validate_and_factorize(
     report = ValidationReport(policy=policy, rows_in=len(starring), rows_out=len(starring))
     if policy == "off":
         return starring, report, None
+    # The future-skew cutoff needs a clock. Callers that replay data — the
+    # streaming delta path, tests, journaled reruns — MUST pass `now`
+    # explicitly so verdicts are deterministic; `None` resolves wall-clock
+    # exactly once here (it used to silently skip the future check, so a
+    # frame of year-3000 timestamps validated clean whenever the caller
+    # forgot the parameter).
+    now = time.time() if now is None else float(now)
 
     # Chaos hook: fail/delay the ingest validation pass itself.
     _VALIDATE_FAULT.hit()

@@ -1,0 +1,41 @@
+"""Streaming stars: incremental fold-in, no full refit (ROADMAP item 4).
+
+Everything before this package was batch: one new star meant retraining the
+world, so the compose loop was hours-stale. This package ingests star
+*deltas* and updates the served model incrementally — the online complement
+of the parallel-ALS literature (arxiv 1508.03110): per-user regularized
+solves against frozen item factors, run as a micro-batched device workload
+exactly like serving (the ALX posture, arxiv 2112.02194).
+
+- ``deltas``  validated delta ingest (the ``datasets.validate`` rule catalog
+  plus delta-specific rules) applied to a :class:`~albedo_tpu.streaming.
+  deltas.StarOverlay` with recency-weighted confidence decay;
+- ``foldin``  micro-batched on-device fold-in solves through the persistent
+  AOT executable cache, watchdog-guarded per batch;
+- ``drift``   the quality monitor that tracks fold-in NDCG@30 on the probe
+  slice against the published ``.meta.json`` canary stamp and decides when
+  the full checkpointed refit is due;
+- ``job``     the ``run_stream`` CLI job wiring deltas -> validated ingest
+  -> fold-in -> stamped hot-swap publish (``serving.reload`` picks the
+  incremental generations up through the normal gates).
+"""
+
+from albedo_tpu.streaming.deltas import (
+    DELTA_COLUMNS,
+    DeltaBatch,
+    StarOverlay,
+    validate_deltas,
+)
+from albedo_tpu.streaming.drift import DriftMonitor, probe_score
+from albedo_tpu.streaming.foldin import FoldInDiverged, FoldInEngine
+
+__all__ = [
+    "DELTA_COLUMNS",
+    "DeltaBatch",
+    "DriftMonitor",
+    "FoldInDiverged",
+    "FoldInEngine",
+    "StarOverlay",
+    "probe_score",
+    "validate_deltas",
+]

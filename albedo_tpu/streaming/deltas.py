@@ -1,0 +1,477 @@
+"""Validated star-delta ingest and the overlay it lands in.
+
+A **delta** is one observed change to the star graph: ``op="star"`` (a new
+or refreshed star) or ``op="unstar"`` (a tombstone). Batches arrive as
+frames with the starring schema plus the ``op`` column (``DELTA_COLUMNS``)
+— what a crawler tail or the synthetic generator
+(``datasets.synthetic_tables.synthetic_delta_stream``) emits.
+
+Ingest reuses the batch firewall's rule catalog (``datasets.validate``:
+confidence, timestamp range against an EXPLICIT stream clock, duplicate
+keep-last, dense-user poison) over the delta rows, plus the delta-specific
+rules:
+
+- **fold-out routing**: a star whose user or repo is outside the base
+  matrix's vocabulary cannot be folded in — item factors are frozen and the
+  serving factor shapes must stay fixed (growth is a refit, not a swap: the
+  same restart-vs-swap boundary the reload invariant gate draws). Such rows
+  are not violations; they are returned as the ``fold_out`` queue and
+  absorbed by the next full refit, which rebuilds the vocabularies.
+- **``dangling_tombstone``**: an un-star of a user/repo the vocabulary has
+  never seen (and, at apply time, of a pair that does not exist) — a real
+  violation, handled per policy like any catalog rule.
+- **``invalid_id``**: a row whose user/repo id failed to parse (the
+  conformer's -1 sentinel) — not an identity at all, so it can be neither
+  folded in nor out; always dropped, counted when the catalog is on.
+- **cross-op keep-last**: the catalog's ``duplicate_pair`` rule runs over
+  the whole batch (stars AND tombstones), so for a pair touched twice the
+  most recent op wins — star-then-unstar leaves the tombstone, and vice
+  versa. Superseded rows are counted but exempt from the ``strict``
+  verdict: resolution is the stream's normal mechanics, not corruption.
+
+Surviving deltas land in a :class:`StarOverlay` over the immutable base
+:class:`~albedo_tpu.datasets.star_matrix.StarMatrix`: per-user upserts and
+tombstones with **recency-weighted confidence decay** — a freshly observed
+star carries ``1 + boost * 2^(-age/half_life)`` confidence, decaying toward
+the base weight 1.0 as it ages, so fold-in solves weight what the user did
+*minutes* ago above what they did months ago. ``materialize()`` and
+``user_row()`` share one merge, so the fold-in inputs are exactly the rows
+a full refit on the materialized matrix would train on (the parity the
+fold-in property test pins).
+
+The ``stream.ingest`` fault site fires at the head of every validation pass.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import TYPE_CHECKING
+
+import numpy as np
+
+from albedo_tpu.datasets.star_matrix import StarMatrix
+from albedo_tpu.datasets.validate import (
+    DataValidationError,
+    ValidationReport,
+    default_policy,
+    validate_starring,
+)
+from albedo_tpu.utils import events, faults
+
+if TYPE_CHECKING:  # pragma: no cover
+    import pandas as pd
+
+log = logging.getLogger(__name__)
+
+_INGEST_FAULT = faults.site("stream.ingest")
+
+DELTA_COLUMNS = ("user_id", "repo_id", "starred_at", "starring", "op")
+OPS = ("star", "unstar")
+
+# Recency weighting defaults: a star observed now counts double; the boost
+# halves every 7 days, so week-old deltas are ~1.5x and month-old ones are
+# back to the base confidence the batch path assigns every star.
+HALF_LIFE_S = 7 * 86_400.0
+RECENCY_BOOST = 1.0
+
+
+@dataclasses.dataclass
+class DeltaBatch:
+    """One validated delta batch, ready to apply.
+
+    ``frame`` holds the surviving rows (known user x known repo, rule-clean,
+    ``starred_at``-ordered, unique per pair — the catalog's keep-last already
+    resolved cross-op duplicates); ``fold_out`` holds the star rows deferred
+    to the next full refit (unknown user or repo: vocabulary growth);
+    ``report`` is the merged :class:`~albedo_tpu.datasets.validate.
+    ValidationReport` (catalog rules + ``dangling_tombstone``).
+    """
+
+    frame: "pd.DataFrame"
+    fold_out: "pd.DataFrame"
+    report: ValidationReport
+
+    @property
+    def n_rows(self) -> int:
+        return int(len(self.frame))
+
+    @property
+    def n_fold_out(self) -> int:
+        return int(len(self.fold_out))
+
+
+def _conform(deltas: "pd.DataFrame") -> "pd.DataFrame":
+    """Delta-schema hygiene: required columns present and typed, ``op``
+    normalized (missing/blank = ``star``), tombstones' ``starring`` forced
+    to 1.0 (a tombstone carries no confidence of its own — without this, a
+    source emitting ``starring=0`` on un-stars would lose every tombstone
+    to the ``nonpositive_confidence`` rule)."""
+    import pandas as pd
+
+    out = pd.DataFrame(index=deltas.index)
+    for col in ("user_id", "repo_id"):
+        if col not in deltas.columns:
+            raise ValueError(f"delta frame is missing required column {col!r}")
+        out[col] = pd.to_numeric(deltas[col], errors="coerce").fillna(-1).astype(np.int64)
+    out["starred_at"] = (
+        pd.to_numeric(deltas["starred_at"], errors="coerce")
+        if "starred_at" in deltas.columns
+        else pd.Series(np.nan, index=deltas.index)
+    ).astype(np.float64)
+    out["starring"] = (
+        pd.to_numeric(deltas["starring"], errors="coerce")
+        if "starring" in deltas.columns
+        else pd.Series(1.0, index=deltas.index)
+    ).astype(np.float64)
+    if "op" in deltas.columns:
+        op = deltas["op"].fillna("star").astype(str).str.strip().str.lower()
+        op = op.where(op.isin(OPS), "star")
+    else:
+        op = pd.Series("star", index=deltas.index)
+    out["op"] = op
+    out.loc[out["op"] == "unstar", "starring"] = 1.0
+    return out
+
+
+def validate_deltas(
+    deltas: "pd.DataFrame",
+    base: StarMatrix,
+    *,
+    now: float | None = None,
+    policy: str | None = None,
+    quarantine_name: str | None = None,
+) -> DeltaBatch:
+    """Run the delta rule set over one batch; returns a :class:`DeltaBatch`.
+
+    ``now`` is the STREAM clock (typically the batch's newest timestamp) —
+    always pass it explicitly when replaying journaled deltas so the
+    ``timestamp_range`` verdicts are deterministic; ``None`` resolves
+    wall-clock once, like the batch validator. ``policy`` follows the
+    firewall contract: ``strict`` raises on any violation (fold-out routing
+    is NOT a violation), ``repair`` drops + quarantines flagged rows,
+    ``off`` skips the catalog (fold-out routing still happens — fold-in
+    physically cannot solve outside the frozen vocabularies).
+    """
+    _INGEST_FAULT.hit()
+    policy = policy or default_policy()
+    frame = _conform(deltas).sort_values("starred_at", kind="stable")
+    rows_in = len(frame)
+
+    # Unparseable/negative ids (the conformer's -1 sentinel) are not
+    # identities at all — they can be neither folded in NOR out (a refit
+    # would train a phantom id -1 user aggregating every corrupt row).
+    # Always dropped; counted as a violation when the catalog is on.
+    bad_id = (frame["user_id"].to_numpy(np.int64) < 0) | (
+        frame["repo_id"].to_numpy(np.int64) < 0
+    )
+    n_bad_id = int(bad_id.sum())
+    if n_bad_id:
+        frame = frame.loc[~bad_id]
+
+    du = base.users_of(frame["user_id"].to_numpy(np.int64))
+    di = base.items_of(frame["repo_id"].to_numpy(np.int64))
+    unknown = (du < 0) | (di < 0)
+    star_op = (frame["op"] == "star").to_numpy()
+    fold_out = frame.loc[unknown & star_op]
+    dangling = int((unknown & ~star_op).sum())
+    known = frame.loc[~unknown]
+
+    if policy == "off":
+        report = ValidationReport(policy=policy, rows_in=rows_in, rows_out=len(known))
+        clean = known
+    else:
+        clean, vreport = validate_starring(
+            known,
+            user_vocab=base.user_ids,
+            repo_vocab=base.item_ids,
+            now=now,
+            # Under strict we still want the COMPLETE rule report (including
+            # the dangling-tombstone count merged below) before raising, so
+            # the catalog pass itself runs in collect-and-drop mode and the
+            # strict verdict is issued here, once, over the merged report.
+            policy="repair",
+            quarantine_name=quarantine_name if policy == "repair" else None,
+        )
+        report = ValidationReport(
+            policy=policy,
+            rows_in=rows_in,
+            rows_out=len(clean),
+            violations=dict(vreport.violations),
+            quarantined_to=vreport.quarantined_to,
+        )
+        if len(fold_out):
+            # Fold-out rows defer to the next refit, but a violating row must
+            # fail HERE, at the ingest that saw it — not cycles later inside
+            # the refit's own strict ingest. The vocab rules are skipped
+            # (unknown ids are the point of the queue); confidence/timestamp/
+            # duplicate rules still apply.
+            fold_out, freport = validate_starring(
+                fold_out,
+                user_vocab=None,
+                repo_vocab=None,
+                now=now,
+                policy="repair",
+                quarantine_name=quarantine_name if policy == "repair" else None,
+            )
+            for rule, n in freport.violations.items():
+                report.violations[rule] = report.violations.get(rule, 0) + n
+            report.quarantined_to = report.quarantined_to or freport.quarantined_to
+        if dangling:
+            report.violations["dangling_tombstone"] = (
+                report.violations.get("dangling_tombstone", 0) + dangling
+            )
+            events.data_violations.inc(dangling, rule="dangling_tombstone")
+        if n_bad_id:
+            report.violations["invalid_id"] = (
+                report.violations.get("invalid_id", 0) + n_bad_id
+            )
+            events.data_violations.inc(n_bad_id, rule="invalid_id")
+        # duplicate_pair is exempt from the strict verdict: cross-op
+        # keep-last is the stream's NORMAL resolution channel (star-then-
+        # unstar resolving to the tombstone), not corruption — like fold-out
+        # routing, it is mechanics, not a violation worth killing a run for.
+        strict_total = sum(
+            n for rule, n in report.violations.items() if rule != "duplicate_pair"
+        )
+        if policy == "strict" and strict_total:
+            raise DataValidationError(report)
+
+    if len(fold_out):
+        events.stream_deltas.inc(len(fold_out), kind="folded_out")
+    superseded = report.violations.get("duplicate_pair", 0)
+    dropped = report.total - superseded
+    if superseded:
+        events.stream_deltas.inc(superseded, kind="superseded")
+    if dropped:
+        events.stream_deltas.inc(dropped, kind="dropped")
+    return DeltaBatch(frame=clean, fold_out=fold_out, report=report)
+
+
+class StarOverlay:
+    """Mutable delta overlay over an immutable base :class:`StarMatrix`.
+
+    The base matrix (and its vocabularies — the dense index space every
+    factor table and serving path is keyed by) never changes; the overlay
+    records per-pair upserts (a star with its observation timestamp) and
+    tombstones. ``user_row``/``materialize`` merge base + overlay with the
+    recency-decayed confidence, sharing one merge so fold-in inputs and the
+    refit-parity matrix can never diverge.
+    """
+
+    # Sentinel timestamp value marking a tombstone in the per-user maps.
+    _TOMBSTONE = None
+
+    def __init__(
+        self,
+        base: StarMatrix,
+        half_life_s: float = HALF_LIFE_S,
+        recency_boost: float = RECENCY_BOOST,
+    ):
+        self.base = base
+        self.half_life_s = float(half_life_s)
+        self.recency_boost = float(recency_boost)
+        self._indptr, self._cols, self._vals = base.csr()
+        # dense user -> {dense item -> starred_at (float) | None (tombstone)}
+        self._delta: dict[int, dict[int, float | None]] = {}
+        # Sorted pair keys of the base nonzeros, for O(log nnz) existence
+        # checks and materialize's removal mapping.
+        self._base_key = base.rows.astype(np.int64) * base.n_items + base.cols
+        self._base_order = np.argsort(self._base_key, kind="stable")
+        self._base_key_sorted = self._base_key[self._base_order]
+        self.applied = 0      # stars applied (lineage: the stamp's delta_count)
+        self.tombstoned = 0
+        self.dangling_tombstones = 0
+
+    # ------------------------------------------------------------- queries
+
+    def _base_nnz_index(self, du: int, di: int) -> int | None:
+        """Position of (du, di) in the base COO arrays, or None."""
+        key = np.int64(du) * self.base.n_items + di
+        pos = int(np.searchsorted(self._base_key_sorted, key))
+        if pos < self._base_key_sorted.shape[0] and self._base_key_sorted[pos] == key:
+            return int(self._base_order[pos])
+        return None
+
+    def has_pair(self, du: int, di: int) -> bool:
+        """Does (du, di) currently hold a star (base or overlay, after
+        tombstones)?"""
+        entry = self._delta.get(int(du), {}).get(int(di), "absent")
+        if entry != "absent":
+            return entry is not self._TOMBSTONE
+        return self._base_nnz_index(int(du), int(di)) is not None
+
+    def confidence(self, starred_at: float, now: float) -> float:
+        """Recency-weighted confidence for an overlay star: ``1 + boost *
+        2^(-age/half_life)``, the base weight 1.0 plus a freshness boost
+        that halves every ``half_life_s``."""
+        age = max(0.0, float(now) - float(starred_at))
+        return 1.0 + self.recency_boost * 2.0 ** (-age / self.half_life_s)
+
+    @property
+    def touched_user_count(self) -> int:
+        return len(self._delta)
+
+    # --------------------------------------------------------------- apply
+
+    def apply(self, batch: DeltaBatch) -> dict:
+        """Apply one validated batch; returns the apply report (counts +
+        the dense indices of every user whose row changed). Rows are unique
+        per pair (the validator's keep-last), so application order within
+        the batch is immaterial."""
+        frame = batch.frame
+        du = self.base.users_of(frame["user_id"].to_numpy(np.int64))
+        di = self.base.items_of(frame["repo_id"].to_numpy(np.int64))
+        ts = frame["starred_at"].to_numpy(np.float64)
+        ops = frame["op"].to_numpy()
+        applied = tombstoned = dangling = 0
+        touched: set[int] = set()
+        for j in range(len(frame)):
+            u, i = int(du[j]), int(di[j])
+            row = self._delta.setdefault(u, {})
+            if ops[j] == "star":
+                row[i] = float(ts[j])
+                applied += 1
+                touched.add(u)
+                continue
+            # Tombstone: retracting an overlay-only star removes the entry
+            # outright (absence restored); a base star needs an explicit
+            # tombstone; a pair that does not currently exist (never seen,
+            # or already un-starred) is a dangling tombstone — validation
+            # could only check the vocabularies; existence is overlay
+            # state, so that verdict lands here.
+            in_base = self._base_nnz_index(u, i) is not None
+            entry = row.get(i, "absent")
+            overlay_star = entry != "absent" and entry is not self._TOMBSTONE
+            exists = overlay_star or (entry == "absent" and in_base)
+            if not exists:
+                dangling += 1
+            elif overlay_star and not in_base:
+                del row[i]
+                tombstoned += 1
+                touched.add(u)
+            else:
+                row[i] = self._TOMBSTONE
+                tombstoned += 1
+                touched.add(u)
+            if not row:
+                # A row emptied back to base state is no longer touched
+                # overlay state (and must not linger in materialize()).
+                del self._delta[u]
+        self.applied += applied
+        self.tombstoned += tombstoned
+        self.dangling_tombstones += dangling
+        if applied:
+            events.stream_deltas.inc(applied, kind="applied")
+        if tombstoned:
+            events.stream_deltas.inc(tombstoned, kind="tombstoned")
+        if dangling:
+            events.stream_deltas.inc(dangling, kind="dangling_tombstone")
+            events.data_violations.inc(dangling, rule="dangling_tombstone")
+        return {
+            "applied": applied,
+            "tombstoned": tombstoned,
+            "dangling_tombstones": dangling,
+            "touched_users": sorted(touched),
+        }
+
+    # --------------------------------------------------------------- reads
+
+    def user_row(self, dense_user: int, now: float) -> tuple[np.ndarray, np.ndarray]:
+        """The user's CURRENT interaction row ``(item_idx, confidence)``:
+        base row minus tombstoned/overridden pairs, plus overlay stars at
+        their decayed confidence. Identical to the same user's row of
+        :meth:`materialize` (shared merge — the fold-in parity anchor)."""
+        du = int(dense_user)
+        lo, hi = int(self._indptr[du]), int(self._indptr[du + 1])
+        cols = self._cols[lo:hi]
+        vals = self._vals[lo:hi]
+        overrides = self._delta.get(du)
+        if not overrides:
+            return cols.astype(np.int32), vals.astype(np.float32)
+        drop = np.isin(cols, np.fromiter(overrides, dtype=np.int64))
+        add_idx = [i for i, t in overrides.items() if t is not self._TOMBSTONE]
+        add_val = [self.confidence(overrides[i], now) for i in add_idx]
+        idx = np.concatenate([cols[~drop], np.asarray(add_idx, dtype=cols.dtype)])
+        val = np.concatenate([vals[~drop], np.asarray(add_val, dtype=np.float32)])
+        return idx.astype(np.int32), val.astype(np.float32)
+
+    def materialize(self, now: float) -> StarMatrix:
+        """The full current matrix over the UNCHANGED base vocabularies
+        (dense indices stay valid for every factor table): base nonzeros
+        minus tombstoned/overridden pairs, plus overlay stars at decayed
+        confidence. Constructed directly — ``from_interactions`` would
+        re-derive (and shrink) the vocabularies, silently re-indexing."""
+        base = self.base
+        keep = np.ones(base.nnz, dtype=bool)
+        add_rows: list[int] = []
+        add_cols: list[int] = []
+        add_vals: list[float] = []
+        for du, overrides in self._delta.items():
+            for di, t in overrides.items():
+                pos = self._base_nnz_index(du, di)
+                if pos is not None:
+                    keep[pos] = False
+                if t is not self._TOMBSTONE:
+                    add_rows.append(du)
+                    add_cols.append(di)
+                    add_vals.append(self.confidence(t, now))
+        return StarMatrix(
+            user_ids=base.user_ids,
+            item_ids=base.item_ids,
+            rows=np.concatenate(
+                [base.rows[keep], np.asarray(add_rows, dtype=base.rows.dtype)]
+            ),
+            cols=np.concatenate(
+                [base.cols[keep], np.asarray(add_cols, dtype=base.cols.dtype)]
+            ),
+            vals=np.concatenate(
+                [base.vals[keep], np.asarray(add_vals, dtype=np.float32)]
+            ),
+        )
+
+    def updated_starring(
+        self,
+        base_starring: "pd.DataFrame",
+        fold_out: "pd.DataFrame | None" = None,
+    ) -> "pd.DataFrame":
+        """The raw ``starring`` table the full refit retrains on: the base
+        table minus tombstoned/overridden pairs, plus overlay stars, plus
+        (optionally) the fold-out queue — so a refit absorbs vocabulary
+        growth the fold-in path deferred. Confidence is re-anchored to the
+        batch path's 1.0 (recency decay is an overlay notion; the refit
+        rebuilds the baseline it decays against)."""
+        import pandas as pd
+
+        uid = base_starring["user_id"].to_numpy(np.int64)
+        rid = base_starring["repo_id"].to_numpy(np.int64)
+        du = self.base.users_of(uid).astype(np.int64)
+        di = self.base.items_of(rid).astype(np.int64)
+        overridden = np.zeros(len(base_starring), dtype=bool)
+        if self._delta:
+            o_keys = np.asarray(
+                [u * self.base.n_items + i for u, m in self._delta.items() for i in m],
+                dtype=np.int64,
+            )
+            known = (du >= 0) & (di >= 0)
+            keys = du * self.base.n_items + di
+            overridden = known & np.isin(keys, o_keys)
+        parts = [base_starring.loc[~overridden]]
+        stars = [
+            (int(self.base.user_ids[u]), int(self.base.item_ids[i]), float(t))
+            for u, m in self._delta.items()
+            for i, t in m.items()
+            if t is not self._TOMBSTONE
+        ]
+        if stars:
+            parts.append(pd.DataFrame(
+                {
+                    "user_id": np.asarray([s[0] for s in stars], dtype=np.int64),
+                    "repo_id": np.asarray([s[1] for s in stars], dtype=np.int64),
+                    "starred_at": np.asarray([s[2] for s in stars], dtype=np.float64),
+                    "starring": np.ones(len(stars), dtype=np.float64),
+                }
+            ))
+        if fold_out is not None and len(fold_out):
+            parts.append(fold_out[["user_id", "repo_id", "starred_at", "starring"]])
+        return pd.concat(parts, ignore_index=True)

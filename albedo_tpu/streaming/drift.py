@@ -1,0 +1,136 @@
+"""Drift monitor: when does fold-in quality decay enough to pay for a refit?
+
+Fold-in against frozen item factors is exact for the touched user rows but
+the item side slowly goes stale: tastes shift, new co-star structure
+accumulates in the overlay, and the frozen Y stops spanning it. The monitor
+quantifies that decay the same way the publish pipeline does — NDCG@30 on
+the deterministic probe slice (``datasets.split.sample_test_users`` + the
+builders' most-recent-30 protocol) — and compares it against the canary
+score recorded in the base artifact's published ``.meta.json`` stamp.
+
+Policy (the ``run_stream`` job's trigger):
+
+- ``score >= baseline * (1 - tolerance)`` and above ``floor``: keep folding
+  (minutes-stale loop, no accelerator hours spent);
+- otherwise: **drifted** — the job schedules ONE full checkpointed refit
+  (through ``builders.pipeline.run_pipeline``, so the preemption/journal/
+  canary machinery of PRs 3-5 runs unchanged), rebases the stream on the
+  refit's matrix + factors, and the monitor's baseline resets to the
+  refit's canary score (no re-trigger loop). Refits are counted in
+  ``albedo_drift_refits_total``.
+
+The ``stream.drift`` fault site fires at the head of every check.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import TYPE_CHECKING
+
+import numpy as np
+
+from albedo_tpu.datasets.star_matrix import StarMatrix
+from albedo_tpu.utils import faults
+
+if TYPE_CHECKING:  # pragma: no cover
+    from albedo_tpu.models.als import ALSModel
+
+log = logging.getLogger(__name__)
+
+DRIFT_FAULT = faults.site("stream.drift")
+
+# Acceptance default: fold-in NDCG@30 on the probe slice must stay within
+# 5% of the published canary stamp.
+DRIFT_TOLERANCE = 0.05
+
+
+def probe_score(
+    model: ALSModel,
+    matrix: StarMatrix,
+    probe_dense: np.ndarray,
+    k: int = 30,
+) -> float:
+    """NDCG@k of ``model`` on the probe users against ``matrix``'s
+    most-recent-k protocol — the same scoring the pipeline's canary gate
+    stamps (``builders.pipeline._canary_score``), parameterized by
+    (model, matrix) so the stream can score fold-in generations against the
+    CURRENT materialized matrix."""
+    from albedo_tpu.evaluators import (
+        RankingEvaluator,
+        user_actual_items,
+        user_items_from_pairs,
+    )
+    from albedo_tpu.recommenders import ALSRecommender
+
+    users = matrix.user_ids[np.asarray(probe_dense, dtype=np.int64)]
+    frame = ALSRecommender(model, matrix, top_k=k).recommend_for_users(users)
+    predicted = user_items_from_pairs(
+        matrix.users_of(frame["user_id"].to_numpy(np.int64)),
+        matrix.items_of(frame["repo_id"].to_numpy(np.int64)),
+        order_key=frame["score"].to_numpy(np.float64),
+        k=k,
+    )
+    actual = user_actual_items(matrix, k=k)
+    return float(
+        RankingEvaluator(metric_name="ndcg@k", k=k).evaluate(predicted, actual)
+    )
+
+
+@dataclasses.dataclass
+class DriftMonitor:
+    """Tracks fold-in quality against the published canary baseline.
+
+    ``baseline`` is the base artifact's stamped canary score (or a probe
+    score computed at stream start when the artifact predates stamping —
+    the record says which). ``history`` keeps every check's verdict for the
+    stream journal.
+    """
+
+    baseline: float | None
+    tolerance: float = DRIFT_TOLERANCE
+    floor: float = 0.0
+    k: int = 30
+    baseline_source: str = "stamp"
+    history: list[dict] = dataclasses.field(default_factory=list)
+    refits: int = 0
+
+    def check(
+        self,
+        model: ALSModel,
+        matrix: StarMatrix,
+        probe_dense: np.ndarray,
+    ) -> dict:
+        """Score the current fold-in generation; returns the verdict record
+        (``drifted`` True schedules the refit)."""
+        DRIFT_FAULT.hit()
+        score = probe_score(model, matrix, probe_dense, k=self.k)
+        reasons = []
+        if self.baseline is not None and score < self.baseline * (1.0 - self.tolerance):
+            reasons.append(
+                f"score {score:.5f} decayed more than {self.tolerance:.0%} "
+                f"below the published canary {self.baseline:.5f}"
+            )
+        if score < self.floor:
+            reasons.append(f"score {score:.5f} below the absolute floor {self.floor:.5f}")
+        record = {
+            "metric": f"ndcg@{self.k}",
+            "score": round(score, 6),
+            "baseline": None if self.baseline is None else round(self.baseline, 6),
+            "baseline_source": self.baseline_source,
+            "tolerance": self.tolerance,
+            "drifted": bool(reasons),
+            "reasons": reasons,
+        }
+        self.history.append(record)
+        if reasons:
+            log.warning("drift monitor tripped: %s", "; ".join(reasons))
+        return record
+
+    def rebase(self, score: float, source: str = "refit") -> None:
+        """A full refit landed: its canary score is the new baseline (the
+        monitor must not keep judging fresh factors against a stamp they
+        just replaced — that is the re-trigger loop this resets)."""
+        self.refits += 1
+        self.baseline = float(score)
+        self.baseline_source = source

@@ -1,0 +1,279 @@
+"""Micro-batched on-device fold-in solves against frozen item factors.
+
+The math is the training sweep's own user-side normal equation (Hu-Koren-
+Volinsky implicit ALS, MLlib's conventions — ``ops.als.bucket_solve_body``):
+
+    x_u = (YtY + Y_u^T diag(alpha c_u) Y_u + reg * n_u * I)^-1
+          Y_u^T (1 + alpha c_u)
+
+with Y (the item factors) FROZEN — exactly what the final user half-sweep of
+a full refit computes given the same item factors, which is why fold-in
+factors match full-refit factors when the item side is unchanged (the
+parity property test pins this). This is the online complement of the
+parallel-ALS-update literature (arxiv 1508.03110): one regularized solve
+per touched user row, no retraining of the world.
+
+Mechanics mirror the serving micro-batcher (the ALX device-residency
+posture, arxiv 2112.02194):
+
+- touched users' rows are padded to a **(pow2 batch, pow2 length)** shape
+  ladder, so the whole stream runs on a handful of fixed shapes;
+- each shape compiles ONCE through ``utils.aot.persistent_aot_executable``
+  and the handle is held — the steady-state cycle is ``compiled(...)`` with
+  no tracing or cache lookup (regularization and alpha are traced arguments,
+  so the damped remediation re-run reuses the same executable);
+- the item factors and their Gramian are uploaded once and stay
+  device-resident across every batch and cycle.
+
+Each batch is guarded by the divergence watchdog's fused health reduction
+(``utils.watchdog.factor_health`` over the solved rows — its single d2h
+read doubles as the batch's completion barrier, the same zero-added-syncs
+contract the training fit uses). A sick batch is re-solved once with the
+standard stabilizers (regularization damped 10x, the ``utils.watchdog``
+remediation recipe); only a trip that survives remediation raises
+:class:`FoldInDiverged` — the cycle fails and nothing publishes.
+
+The ``stream.foldin`` fault site fires ahead of every batch solve: an
+``error`` kind scribbles NaN into the solved rows so chaos drills exercise
+the real detect -> remediate path (the ``train.watchdog`` convention), and
+a ``kill`` kind dies mid-fold-in — the half-applied state must never reach
+the artifact store (pinned by the chaos drill).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import TYPE_CHECKING
+
+import numpy as np
+
+from albedo_tpu.utils import events, faults
+from albedo_tpu.utils import pow2_at_least as _pow2
+from albedo_tpu.utils.aot import persistent_aot_executable
+from albedo_tpu.utils.faults import FaultInjected
+
+if TYPE_CHECKING:  # pragma: no cover
+    from albedo_tpu.models.als import ALSModel
+
+log = logging.getLogger(__name__)
+
+FOLDIN_FAULT = faults.site("stream.foldin")
+
+_foldin_solve_jit = None
+
+
+def _foldin_solve():
+    """The jitted per-batch program: gather -> fused Gramian correction ->
+    batched solve (``ops.als.bucket_solve_body``, the training kernel —
+    sharing it is what makes fold-in/refit parity a theorem, not a test
+    hope). Built lazily so the jit closure and the ``ops.als`` import are
+    paid at first solve, not at module import."""
+    global _foldin_solve_jit
+    if _foldin_solve_jit is None:
+        import jax
+
+        from albedo_tpu.ops.als import bucket_solve_body
+
+        def solve(vf, yty, idx, val, mask, reg, alpha):
+            return bucket_solve_body(vf, yty, idx, val, mask, reg, alpha)
+
+        _foldin_solve_jit = jax.jit(solve)
+    return _foldin_solve_jit
+
+
+class FoldInDiverged(RuntimeError):
+    """A fold-in batch stayed non-finite/oversized after the damped re-solve;
+    the touched rows are garbage and the cycle must not publish."""
+
+    def __init__(self, batch_users: int, health: dict):
+        super().__init__(
+            f"fold-in batch of {batch_users} user(s) diverged and the damped "
+            f"re-solve did not recover (health={health}); refusing to fold in"
+        )
+        self.health = health
+
+
+class FoldInEngine:
+    """Holds the frozen item factors on device and solves touched user rows.
+
+    ``reg_param``/``alpha`` must match the hyperparameters the base model
+    was trained with — fold-in is the training solve, so a mismatched
+    regularization would bias every folded row relative to the refit path.
+    ``max_batch`` bounds the user-axis bucket (requests beyond it split into
+    multiple dispatches); ``max_rms`` is the watchdog norm ceiling.
+    """
+
+    def __init__(
+        self,
+        model: ALSModel,
+        reg_param: float | None = None,
+        alpha: float | None = None,
+        max_batch: int = 64,
+        max_rms: float = 1e4,
+    ):
+        import jax.numpy as jnp
+
+        from albedo_tpu.models.als import ImplicitALS
+        from albedo_tpu.ops.als import gramian
+
+        # None = the estimator's own defaults, so an engine built without
+        # explicit hyperparameters matches a model trained without them.
+        self.rank = int(model.rank)
+        self.reg_param = float(ImplicitALS.reg_param if reg_param is None else reg_param)
+        self.alpha = float(ImplicitALS.alpha if alpha is None else alpha)
+        self.max_batch = max(1, _pow2(int(max_batch)))
+        self.max_rms = float(max_rms)
+        # Frozen item side, uploaded once: the factors and their Gramian are
+        # shared by every batch of every cycle.
+        self._vf = jnp.asarray(np.asarray(model.item_factors, dtype=np.float32))
+        self._yty = gramian(self._vf)
+        self._executables: dict[tuple[int, int], object] = {}
+        self.batches_run = 0
+        self.users_solved = 0
+        self.trips = 0
+        self.last_batch_s = 0.0
+
+    # ----------------------------------------------------------- executables
+
+    def _executable(self, bucket: int, length: int):
+        """(pow2 users, pow2 row length) -> compiled handle via the AOT
+        caches (same keying discipline as ``serving.batcher``: everything
+        the program depends on beyond traced values is in the key)."""
+        import jax
+        import jax.numpy as jnp
+
+        key = (bucket, length)
+        compiled = self._executables.get(key)
+        if compiled is not None:
+            return compiled
+        idx = np.zeros((bucket, length), dtype=np.int32)
+        val = np.zeros((bucket, length), dtype=np.float32)
+        mask = np.zeros((bucket, length), dtype=bool)
+        args = (
+            self._vf, self._yty, idx, val, mask,
+            jnp.float32(self.reg_param), jnp.float32(self.alpha),
+        )
+        key_parts = (
+            "stream_foldin", bucket, length, self.rank,
+            tuple(self._vf.shape), str(self._vf.dtype),
+            jax.__version__, jax.default_backend(),
+        )
+        compiled, compile_s, source = persistent_aot_executable(
+            _foldin_solve(), args, None, None, key_parts, name="stream_foldin",
+        )
+        if source != "memory":
+            log.info(
+                "fold-in shape (users=%d, len=%d) ready (%s, %.2fs)",
+                bucket, length, source, compile_s,
+            )
+        self._executables[key] = compiled
+        return compiled
+
+    def warm(self, lengths: tuple[int, ...], buckets: tuple[int, ...] | None = None) -> int:
+        """Pre-compile the shape ladder for the given row lengths (pow2-
+        quantized); returns how many executables were prepared."""
+        buckets = buckets or (self.max_batch,)
+        for b in buckets:
+            for ln in sorted({_pow2(max(1, int(n))) for n in lengths}):
+                self._executable(_pow2(max(1, int(b))), ln)
+        return len(self._executables)
+
+    # ----------------------------------------------------------------- solve
+
+    def fold_in(
+        self, rows: list[tuple[np.ndarray, np.ndarray]]
+    ) -> np.ndarray:
+        """Solve the given user rows against the frozen item factors.
+
+        ``rows`` is one ``(item_idx, confidence)`` pair per touched user
+        (what ``StarOverlay.user_row`` returns). Empty rows are the caller's
+        concern — a user whose every star was tombstoned keeps their OLD
+        factors, matching the training path, where a row in no bucket lands
+        nothing (see ``models.als._landing_perm``). Returns ``(len(rows),
+        rank)`` float32 factors.
+        """
+        if not rows:
+            return np.zeros((0, self.rank), dtype=np.float32)
+        if any(int(idx.size) == 0 for idx, _ in rows):
+            raise ValueError(
+                "empty user row passed to fold_in — keep the old factors for "
+                "fully-tombstoned users instead (training-path semantics)"
+            )
+        out = np.empty((len(rows), self.rank), dtype=np.float32)
+        for lo in range(0, len(rows), self.max_batch):
+            chunk = rows[lo:lo + self.max_batch]
+            out[lo:lo + len(chunk)] = self._solve_chunk(chunk)
+        return out
+
+    def _solve_chunk(self, chunk: list[tuple[np.ndarray, np.ndarray]]) -> np.ndarray:
+        import jax.numpy as jnp
+
+        from albedo_tpu.utils.watchdog import factor_health, health_dict
+
+        t0 = time.perf_counter()
+        bucket = _pow2(len(chunk))
+        length = _pow2(max(int(idx.size) for idx, _ in chunk))
+        idx = np.zeros((bucket, length), dtype=np.int32)
+        val = np.zeros((bucket, length), dtype=np.float32)
+        mask = np.zeros((bucket, length), dtype=bool)
+        for r, (ri, rv) in enumerate(chunk):
+            n = int(ri.size)
+            idx[r, :n] = ri
+            val[r, :n] = rv
+            mask[r, :n] = True
+
+        # Chaos hook, armed BEFORE the solve so a `kill` kind dies genuinely
+        # mid-fold-in; an `error` kind scribbles NaN into the solved rows so
+        # the detect -> remediate path below runs for real (the
+        # train.watchdog convention).
+        scribble = False
+        try:
+            FOLDIN_FAULT.hit()
+        except FaultInjected:
+            scribble = True
+
+        compiled = self._executable(bucket, length)
+        # RMS over the padded bucket dilutes by the zero rows; undo it so the
+        # verdict matches the unpadded reduction.
+        rms_scale = (bucket / len(chunk)) ** 0.5
+
+        def run(reg: float):
+            return compiled(
+                self._vf, self._yty, idx, val, mask,
+                jnp.float32(reg), jnp.float32(self.alpha),
+            )
+
+        def check(solved_dev) -> dict:
+            # The watchdog health reduction guards every batch ON DEVICE at
+            # the padded bucket shape (ladder shapes only — no per-chunk
+            # retrace): its single d2h read is the completion barrier, the
+            # same zero-added-syncs contract the training fit uses.
+            health = health_dict(factor_health(solved_dev, solved_dev))
+            health["rms"] *= rms_scale
+            return health
+
+        solved_dev = run(self.reg_param)
+        if scribble:
+            # Chaos-only path: poison the host copy and judge that, so the
+            # detect -> remediate flow below runs for real.
+            poisoned = np.asarray(solved_dev, dtype=np.float32)[: len(chunk)].copy()
+            poisoned.flat[0] = np.nan
+            health = health_dict(factor_health(poisoned, poisoned))
+        else:
+            health = check(solved_dev)
+        if health["nonfinite"] or health["rms"] > self.max_rms:
+            self.trips += 1
+            events.watchdog_trips.inc(kind="foldin")
+            log.warning(
+                "fold-in batch tripped the watchdog (%s); re-solving damped",
+                health,
+            )
+            solved_dev = run(self.reg_param * 10.0)
+            health = check(solved_dev)
+            if health["nonfinite"] or health["rms"] > self.max_rms:
+                raise FoldInDiverged(len(chunk), health)
+        self.batches_run += 1
+        self.users_solved += len(chunk)
+        self.last_batch_s = time.perf_counter() - t0
+        return np.asarray(solved_dev, dtype=np.float32)[: len(chunk)]

@@ -1,0 +1,522 @@
+"""``run_stream``: the minutes-stale compose loop, one command.
+
+Wires the streaming subsystem end to end, per cycle::
+
+    delta batch (crawler tail files, or the synthetic stream)
+        │  validated delta ingest   (streaming.deltas: rule catalog + fold-out
+        │                            routing + tombstones, stream-clock `now`)
+        │  overlay apply            (recency-decayed confidence upserts)
+        │  fold-in                  (streaming.foldin: micro-batched device
+        │                            solves, watchdog-guarded)
+        │  drift check              (streaming.drift: probe NDCG@30 vs the
+        │                            published canary stamp)
+        │    └─ drifted / fold-out overflow → ONE full checkpointed refit
+        │       (builders.pipeline.run_pipeline: ingest→train_als→canary with
+        │        the PR 3-5 journal/preemption/canary machinery), then rebase
+        ▼
+    stamped publish  (alsModel-...-stream-g<N>.pkl + .sha256 manifest +
+                      .meta.json lineage stamp: base artifact hash + delta
+                      count — `serve --reload-watch` hot-swaps it through the
+                      normal reload gates)
+
+Every cycle lands in the stream journal
+(``<tag>-stream-journal.json``). Exit codes follow the pipeline contract:
+0 ok, 1 stage failure, 3 fold-in divergence, 4 refit refused by the canary
+gate, 75 preempted.
+
+Staleness model: the serving swap lag is one watch interval behind the
+publish, the publish is one cycle behind the crawl — minutes, not the
+hours-stale full-pipeline loop. Vocabulary growth (new users/repos) stays
+on the refit path by construction: fold-in cannot grow frozen factor
+tables, and the serving reload's invariant gate treats a shape change as a
+restart, not a swap.
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+from pathlib import Path
+
+import numpy as np
+
+from albedo_tpu.cli import register_job
+from albedo_tpu.streaming.deltas import StarOverlay, validate_deltas
+from albedo_tpu.streaming.drift import DriftMonitor, probe_score
+from albedo_tpu.streaming.foldin import FoldInDiverged, FoldInEngine
+from albedo_tpu.utils import events
+from albedo_tpu.utils.jsonio import atomic_write_json
+
+JOURNAL_NAME = "stream-journal.json"
+
+
+class StreamState:
+    """Everything a cycle mutates, rebased wholesale after a refit."""
+
+    def __init__(self, ctx, model, matrix, opts):
+        self.opts = opts
+        self.base_artifact_name = ctx.als_artifact_name()
+        self.rebase(model, matrix, probe_ctx=ctx)
+        self.fold_out_frames: list = []
+        t_max = float(ctx.tables().starring["starred_at"].max())
+        self.now = t_max if np.isfinite(t_max) and t_max > 0 else time.time()
+        self.generation = 0
+        self.delta_count = 0   # lineage: applied deltas since the CURRENT base
+        self.deltas_total = 0  # run total, survives refit rebases
+
+    def rebase(self, model, matrix, probe_ctx) -> None:
+        from albedo_tpu.builders.jobs import ALS_ALPHA, ALS_REG
+
+        # The context whose tables/matrix are CURRENT: after a refit this is
+        # the refit's own context, so the next refit trains on the data the
+        # previous one absorbed, not the original boot tables.
+        self.ctx = probe_ctx
+        self.model_base = model
+        self.matrix = matrix
+        self.overlay = StarOverlay(
+            matrix,
+            half_life_s=self.opts.half_life_days * 86_400.0,
+            recency_boost=self.opts.recency_boost,
+        )
+        # Fold-in must solve with the SAME regularization/alpha the base
+        # artifact was trained with (the builders.jobs shared defaults).
+        self.engine = FoldInEngine(
+            model, reg_param=ALS_REG, alpha=ALS_ALPHA,
+            max_batch=self.opts.max_foldin_batch,
+        )
+        self.uf = np.array(model.user_factors, dtype=np.float32, copy=True)
+        self.vf = np.asarray(model.item_factors, dtype=np.float32)
+        self.rank = int(model.rank)
+        self.probe_dense = probe_ctx.test_user_dense(self.opts.probe_users)
+
+    @property
+    def fold_out_rows(self) -> int:
+        return int(sum(len(f) for f in self.fold_out_frames))
+
+    def live_model(self):
+        from albedo_tpu.models.als import ALSModel
+
+        return ALSModel(self.uf, self.vf, rank=self.rank)
+
+
+def _delta_batches(ctx, state: StreamState, opts) -> list:
+    """The cycle's delta source: ``--deltas`` files (one batch per file —
+    the crawler-tail seam; EVERY file is a cycle, ``--cycles`` only sizes
+    the synthetic stream), else the hermetic synthetic stream.
+
+    File batches replay in CHRONOLOGICAL order (each batch's newest
+    parseable timestamp; name as tie-break, timestamp-less files last).
+    Lexicographic names would put ``batch-10`` before ``batch-2`` — and the
+    overlay is last-write-wins per pair, so an out-of-order replay would
+    let an old star overwrite a newer tombstone."""
+    import pandas as pd
+
+    if opts.deltas:
+        src = Path(opts.deltas)
+        files = (
+            sorted([*src.glob("*.csv"), *src.glob("*.parquet")])
+            if src.is_dir() else [src]
+        )
+        loaded = []
+        for f in files:
+            frame = pd.read_parquet(f) if f.suffix == ".parquet" else pd.read_csv(f)
+            t_max = float("inf")
+            if "starred_at" in frame.columns and len(frame):
+                t = float(pd.to_numeric(frame["starred_at"], errors="coerce").max())
+                if np.isfinite(t):
+                    t_max = t
+            loaded.append((t_max, f.name, frame))
+        loaded.sort(key=lambda item: item[:2])
+        return [frame for _, _, frame in loaded]
+    from albedo_tpu.datasets.synthetic_tables import synthetic_delta_stream
+
+    return synthetic_delta_stream(
+        state.matrix,
+        n_batches=opts.cycles,
+        batch_size=opts.delta_batch,
+        seed=opts.stream_seed,
+        start_at=state.now + 60.0,
+    )
+
+
+def _advance_clock(now: float, batch) -> float:
+    """Monotone stream clock from a RAW delta batch: the newest parseable
+    timestamp, never backwards. Raw ``--deltas`` files may lack the column
+    or carry junk the conformer later coerces — the clock must tolerate
+    everything ``_conform`` does (and NaN must not poison it)."""
+    import pandas as pd
+
+    if "starred_at" not in batch.columns:
+        return now
+    t_max = float(pd.to_numeric(batch["starred_at"], errors="coerce").max())
+    return max(now, t_max) if np.isfinite(t_max) else now
+
+
+def _grown_tables(tables, starring):
+    """RawTables for the refit: the updated starring plus vocabulary stub
+    rows for ids the entity tables have never seen (the fold-out queue's new
+    users/repos). A real deployment backfills these from the crawler's
+    entity fetch; the stub keeps the refit's validated ingest from dropping
+    the queued growth as dangling while that crawl lags."""
+    import pandas as pd
+
+    from albedo_tpu.datasets.tables import RawTables
+
+    user_info, repo_info = tables.user_info, tables.repo_info
+    new_u = np.setdiff1d(
+        starring["user_id"].to_numpy(np.int64), user_info["user_id"].to_numpy(np.int64)
+    )
+    new_r = np.setdiff1d(
+        starring["repo_id"].to_numpy(np.int64), repo_info["repo_id"].to_numpy(np.int64)
+    )
+    if new_u.size:
+        user_info = pd.concat(
+            [user_info, pd.DataFrame({"user_id": new_u})], ignore_index=True
+        )
+    if new_r.size:
+        repo_info = pd.concat(
+            [repo_info, pd.DataFrame({"repo_id": new_r})], ignore_index=True
+        )
+    return RawTables(
+        user_info=user_info, repo_info=repo_info,
+        starring=starring, relation=tables.relation,
+    ).conformed()
+
+
+def _full_refit(ctx, args, state: StreamState, refit_no: int) -> dict:
+    """One full checkpointed refit through ``builders.pipeline.run_pipeline``
+    (ingest -> train_als -> canary): preemption-safe checkpointing, stage
+    journal, canary stamp — the PR 3-5 machinery untouched. Returns the
+    refit record; rebases ``state`` on the fresh matrix + factors."""
+    import pandas as pd
+
+    from albedo_tpu.builders.jobs import JobContext
+    from albedo_tpu.builders.pipeline import run_pipeline
+    from albedo_tpu.settings import md5
+
+    fold_out = (
+        pd.concat(state.fold_out_frames, ignore_index=True)
+        if state.fold_out_frames else None
+    )
+    # state.ctx, not ctx: after the first refit the current tables are the
+    # refit's (they contain every delta it absorbed); rebuilding from the
+    # boot context would silently drop all previously-absorbed history.
+    starring = state.overlay.updated_starring(
+        state.ctx.tables().starring, fold_out=fold_out
+    )
+    tables = _grown_tables(state.ctx.tables(), starring)
+    rargs = argparse.Namespace(**vars(args))
+    # The refit is checkpointed by contract: preemption mid-refit must
+    # resume, not restart (the global --checkpoint-every wins when set).
+    if not getattr(rargs, "checkpoint_every", 0):
+        rargs.checkpoint_every = state.opts.refit_checkpoint_every
+    rargs.resume = False
+    refit_tag = md5(f"{ctx.tag}-stream-refit-{refit_no}")[:10]
+    rctx = JobContext(rargs, tables=tables, tag=refit_tag)
+    journal = run_pipeline(
+        rctx, stages=["ingest", "train_als", "canary"], verbose=True
+    )
+    events.drift_refits.inc()
+    canary = journal["stages"]["canary"]["result"] or {}
+    score = float(canary.get("score") or 0.0)
+    state.base_artifact_name = rctx.als_artifact_name()
+    state.rebase(rctx.als_model(), rctx.matrix(), probe_ctx=rctx)
+    state.fold_out_frames = []
+    # Lineage: delta_count is "applied since the base artifact", and the
+    # refit IS the new base — everything folded so far is inside it.
+    state.delta_count = 0
+    return {
+        "tag": refit_tag,
+        "artifact": rctx.als_artifact_name(),
+        "journal_status": journal["status"],
+        "canary_score": score,
+        "n_users": int(rctx.matrix().n_users),
+        "n_items": int(rctx.matrix().n_items),
+    }
+
+
+def _publish(
+    ctx, state: StreamState, score: float | None, keep: int, measured: bool
+) -> dict:
+    """Write the incremental generation: pickle + ``.sha256`` manifest +
+    ``.meta.json`` lineage stamp. The manifest lands LAST, which is what
+    tells the reload watcher the write is sealed — a death mid-publish
+    leaves an unsealed file no watcher will ever attempt (the
+    never-half-applied guarantee the chaos drill pins)."""
+    from albedo_tpu.datasets import artifacts as store
+
+    state.generation += 1
+    g = state.generation
+    name = ctx.artifact_name(f"{ctx.als_key()}-stream-g{g}.pkl")
+    path = store.artifact_path(name)
+    base_path = store.artifact_path(state.base_artifact_name)
+    base_sha = store.read_manifest_sha(base_path) or (
+        store.file_sha256(base_path) if base_path.exists() else None
+    )
+    store.save_pickle(path, state.live_model().to_arrays())
+    store.write_meta(path, {
+        "canary": {
+            "metric": "ndcg@30",
+            "score": None if score is None else round(float(score), 6),
+            "passed": True,
+            # Honesty for the stamp gate's regression check: "drift_check"
+            # means this generation was scored this cycle; "inherited" means
+            # the score carries over from the last check inside a
+            # --drift-every window and was NOT measured on these factors.
+            "source": "drift_check" if measured else "inherited",
+        },
+        "lineage": {
+            "base_artifact": base_path.name,
+            "base_sha256": base_sha,
+            "delta_count": int(state.delta_count),
+            "stream_generation": g,
+            "fold_out_queue_rows": state.fold_out_rows,
+            "n_users": int(state.matrix.n_users),
+            "n_items": int(state.matrix.n_items),
+        },
+    })
+    store.write_manifest(path)
+    events.stream_publishes.inc(outcome="published")
+    # Retention: the serving watcher baselines what it has seen, so old
+    # stream generations are dead weight past a rollback horizon.
+    stale = sorted(
+        path.parent.glob(f"{ctx.artifact_name(ctx.als_key())}-stream-g*.pkl"),
+        key=lambda p: p.stat().st_mtime,
+    )
+    for old in stale[:-max(1, keep)]:
+        for victim in (old, store.manifest_path(old), store.meta_path(old)):
+            try:
+                victim.unlink()
+            except OSError:
+                pass
+    return {"artifact": name, "generation": g}
+
+
+def run_stream(ctx, args, opts) -> dict:
+    """Drive ``opts.cycles`` stream cycles; returns the stream journal."""
+    from albedo_tpu.datasets import artifacts as store
+
+    t0 = time.time()
+    model = ctx.als_model()
+    matrix = ctx.matrix()
+    state = StreamState(ctx, model, matrix, opts)
+
+    base_path = store.artifact_path(ctx.als_artifact_name())
+    meta = store.read_meta(base_path) or {}
+    baseline = (meta.get("canary") or {}).get("score")
+    if baseline is not None:
+        monitor = DriftMonitor(
+            baseline=float(baseline), tolerance=opts.drift_tolerance,
+            floor=opts.drift_floor, baseline_source="stamp",
+        )
+    else:
+        # Unstamped base artifact (trained outside run_pipeline): anchor the
+        # baseline with one probe pass so drift is still measurable.
+        monitor = DriftMonitor(
+            baseline=probe_score(model, matrix, state.probe_dense),
+            tolerance=opts.drift_tolerance, floor=opts.drift_floor,
+            baseline_source="probe",
+        )
+
+    journal: dict = {
+        "tag": ctx.tag,
+        "base_artifact": base_path.name,
+        "status": "running",
+        "baseline": {
+            "score": monitor.baseline, "source": monitor.baseline_source,
+        },
+        "cycles": [],
+    }
+    journal_path = store.artifact_path(ctx.artifact_name(JOURNAL_NAME))
+
+    def save() -> None:
+        journal["updated_at"] = time.time()
+        atomic_write_json(journal_path, journal, indent=2)
+
+    save()
+    batches = _delta_batches(ctx, state, opts)
+    refit_no = 0
+    last_score: float | None = monitor.baseline
+    policy = ctx.data_policy()
+
+    for cycle, batch in enumerate(batches, start=1):
+        c0 = time.time()
+        record: dict = {"cycle": cycle, "status": "running"}
+        journal["cycles"].append(record)
+        try:
+            state.now = _advance_clock(state.now, batch)
+
+            # 1. Validated delta ingest against the stream clock.
+            dbatch = validate_deltas(
+                batch, state.matrix, now=state.now, policy=policy,
+                quarantine_name=(
+                    ctx.artifact_name("stream-deltas") if policy == "repair" else None
+                ),
+            )
+            if dbatch.n_fold_out:
+                state.fold_out_frames.append(dbatch.fold_out)
+            apply_report = state.overlay.apply(dbatch)
+            applied_now = apply_report["applied"] + apply_report["tombstoned"]
+            state.delta_count += applied_now
+            state.deltas_total += applied_now
+            record["ingest"] = {
+                **dbatch.report.to_dict(),
+                "fold_out": dbatch.n_fold_out,
+                **{k: v for k, v in apply_report.items() if k != "touched_users"},
+            }
+
+            # 2. Fold-in: one regularized device solve per touched user row.
+            touched = apply_report["touched_users"]
+            rows, t_idx, kept_empty = [], [], 0
+            for du in touched:
+                idx, val = state.overlay.user_row(du, state.now)
+                if idx.size:
+                    rows.append((idx, val))
+                    t_idx.append(du)
+                else:
+                    kept_empty += 1  # fully-tombstoned: keep old factors
+            batches_before = state.engine.batches_run
+            f0 = time.perf_counter()
+            if rows:
+                state.uf[np.asarray(t_idx, dtype=np.int64)] = state.engine.fold_in(rows)
+            foldin_s = time.perf_counter() - f0
+            events.foldin_users.inc(len(rows))
+            record["foldin"] = {
+                "touched_users": len(touched),
+                "solved": len(rows),
+                "kept_empty": kept_empty,
+                "batches": state.engine.batches_run - batches_before,
+                "foldin_s": round(foldin_s, 4),
+            }
+
+            # 3. Drift check (every --drift-every cycles) + refit trigger.
+            refit_due, why = False, []
+            if cycle % max(1, opts.drift_every) == 0:
+                verdict = monitor.check(
+                    state.live_model(), state.overlay.materialize(state.now),
+                    state.probe_dense,
+                )
+                last_score = verdict["score"]
+                record["drift"] = verdict
+                if verdict["drifted"]:
+                    refit_due, why = True, list(verdict["reasons"])
+            if opts.foldout_limit and state.fold_out_rows > opts.foldout_limit:
+                refit_due = True
+                why.append(
+                    f"fold-out queue ({state.fold_out_rows} rows) past "
+                    f"--foldout-limit {opts.foldout_limit}"
+                )
+            if refit_due:
+                refit_no += 1
+                print(f"[run_stream] scheduling full refit #{refit_no}: {'; '.join(why)}")
+                refit = _full_refit(ctx, args, state, refit_no)
+                monitor.rebase(refit["canary_score"])
+                last_score = refit["canary_score"]
+                record["refit"] = {**refit, "reasons": why}
+
+            # 4. Stamped publish — the reload watcher's hot-swap input.
+            if not opts.no_publish:
+                record["publish"] = _publish(
+                    ctx, state, last_score, keep=opts.keep_stream,
+                    measured="drift" in record or "refit" in record,
+                )
+        except BaseException as e:
+            # The failing cycle must land in the journal ("every cycle lands")
+            # before the exit-code contract takes over — an operator triaging
+            # exit 3/4/75 needs to see WHICH cycle died and why.
+            from albedo_tpu.utils.checkpoint import Preempted
+
+            status = "preempted" if isinstance(e, Preempted) else "failed"
+            record.update(
+                status=status,
+                error=f"{type(e).__name__}: {e}",
+                cycle_s=round(time.time() - c0, 3),
+            )
+            journal["status"] = status
+            save()
+            raise
+
+        record.update(status="done", cycle_s=round(time.time() - c0, 3))
+        save()
+        print(
+            f"[run_stream] cycle {cycle}: applied={apply_report['applied']} "
+            f"tombstoned={apply_report['tombstoned']} "
+            f"fold_out={dbatch.n_fold_out} solved={len(rows)} "
+            f"foldin_s={foldin_s:.3f}"
+            + (f" score={last_score:.5f}" if last_score is not None else "")
+            + (f" REFIT#{refit_no}" if refit_due else "")
+        )
+
+    journal["status"] = "complete"
+    journal["summary"] = {
+        "cycles": len(journal["cycles"]),
+        "deltas_applied": int(state.deltas_total),
+        "refits": refit_no,
+        "publishes": int(state.generation),
+        "fold_out_rows": state.fold_out_rows,
+        "last_score": last_score,
+        "wall_clock_s": round(time.time() - t0, 2),
+    }
+    save()
+    return journal
+
+
+@register_job("run_stream")
+def run_stream_job(args) -> int | None:
+    """Incremental fold-in streaming (see module docstring).
+
+    Extra flags: --cycles N (synthetic batch count, default 3), --delta-batch
+    N (synthetic rows per cycle, default 200), --stream-seed N, --deltas PATH
+    (csv/parquet delta files, one batch per file, EVERY file a cycle,
+    instead of the synthetic stream),
+    --drift-tolerance FRAC (default 0.05), --drift-floor SCORE,
+    --drift-every N (default 1), --half-life-days D (confidence decay,
+    default 7), --recency-boost B (default 1.0), --foldout-limit ROWS
+    (queue size that forces a refit, default 500; 0 = never),
+    --max-foldin-batch N (default 64), --probe-users N (default 150),
+    --no-publish, --keep-stream N (stream artifact retention, default 3),
+    --refit-checkpoint-every N (default 4). Honors the global --data-policy,
+    --checkpoint-every, --small, --tables.
+    """
+    from albedo_tpu.builders.jobs import JobContext
+    from albedo_tpu.builders.pipeline import PipelineStageFailed, PublishRejected
+
+    extra = argparse.ArgumentParser()
+    extra.add_argument("--cycles", type=int, default=3)
+    extra.add_argument("--delta-batch", type=int, default=200)
+    extra.add_argument("--stream-seed", type=int, default=7)
+    extra.add_argument("--deltas", default="")
+    extra.add_argument("--drift-tolerance", type=float, default=0.05)
+    extra.add_argument("--drift-floor", type=float, default=0.0)
+    extra.add_argument("--drift-every", type=int, default=1)
+    extra.add_argument("--half-life-days", type=float, default=7.0)
+    extra.add_argument("--recency-boost", type=float, default=1.0)
+    extra.add_argument("--foldout-limit", type=int, default=500)
+    extra.add_argument("--max-foldin-batch", type=int, default=64)
+    extra.add_argument("--probe-users", type=int, default=150)
+    extra.add_argument("--no-publish", action="store_true")
+    extra.add_argument("--keep-stream", type=int, default=3)
+    extra.add_argument("--refit-checkpoint-every", type=int, default=4)
+    opts, _ = extra.parse_known_args(getattr(args, "_rest", []))
+
+    t0 = time.time()
+    ctx = JobContext(args)
+    try:
+        journal = run_stream(ctx, args, opts)
+    except FoldInDiverged as e:
+        print(f"[run_stream] FOLD-IN DIVERGED: {e} (nothing published this cycle)")
+        return 3
+    except PublishRejected as e:
+        print(f"[run_stream] REFIT REFUSED by the canary gate: {e}")
+        return 4
+    except PipelineStageFailed as e:
+        print(f"[run_stream] REFIT FAILED: {e}")
+        return 1
+    s = journal["summary"]
+    print(
+        f"[run_stream] {s['cycles']} cycle(s): {s['deltas_applied']} deltas "
+        f"applied, {s['publishes']} publish(es), {s['refits']} refit(s), "
+        f"fold-out queue {s['fold_out_rows']} row(s)"
+    )
+    print(f"[run_stream] wall-clock = {time.time() - t0:.1f}s")
+    return None

@@ -253,3 +253,28 @@ publish_rejected = global_counter(
     "(canary = pipeline quality gate, stamp = serving reload stamp gate).",
     ("gate",),
 )
+# The streaming plane (ROADMAP item 4): delta ingest routing, fold-in
+# throughput, and the drift monitor's refit trigger.
+stream_deltas = global_counter(
+    "albedo_stream_deltas_total",
+    "Star deltas processed by the streaming ingest, by disposition "
+    "(applied/tombstoned/folded_out = deferred to the next refit/"
+    "dangling_tombstone/superseded = cross-op keep-last resolution/"
+    "dropped = validation).",
+    ("kind",),
+)
+foldin_users = global_counter(
+    "albedo_foldin_users_total",
+    "User rows re-solved on device by the streaming fold-in engine.",
+)
+drift_refits = global_counter(
+    "albedo_drift_refits_total",
+    "Full checkpointed refits triggered by the streaming drift monitor "
+    "(quality decay past tolerance, or fold-out queue overflow).",
+)
+stream_publishes = global_counter(
+    "albedo_stream_publishes_total",
+    "Incremental stream generations published to the artifact store, by "
+    "outcome.",
+    ("outcome",),
+)

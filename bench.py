@@ -1346,7 +1346,122 @@ def datacheck_bench() -> dict:
     }
 
 
-SCENARIOS = {"serving": serving_bench, "datacheck": datacheck_bench}
+def foldin_bench() -> dict:
+    """The `foldin` scenario: incremental fold-in vs retrain-the-world.
+
+    One base model is trained once; each trial then takes a fresh synthetic
+    delta batch and runs BOTH arms over the same updated data — arm A is a
+    full stream cycle (validated delta ingest -> overlay apply -> device
+    fold-in of the touched user rows), arm B is a full refit
+    (``ImplicitALS.fit`` on the materialized matrix). Trials are
+    interleaved A/B/A/B with median reporting (2-vCPU bench box throttles;
+    interleaving hits both arms equally). The record carries the fold-in
+    latency per touched-user batch, sustained deltas/sec through the whole
+    cycle, and the refit/fold-in wall-clock ratio — the number that says
+    what the streaming path buys. Env knobs: ALBEDO_FOLDIN_USERS/ITEMS/
+    MEAN_STARS/DELTA_BATCH/TRIALS/RANK/ITERS.
+    """
+    import statistics
+
+    from albedo_tpu.datasets.synthetic import synthetic_stars
+    from albedo_tpu.datasets.synthetic_tables import synthetic_delta_stream
+    from albedo_tpu.models.als import ImplicitALS
+    from albedo_tpu.streaming.deltas import StarOverlay, validate_deltas
+    from albedo_tpu.streaming.foldin import FoldInEngine
+
+    n_users = int(os.environ.get("ALBEDO_FOLDIN_USERS", "5000"))
+    n_items = int(os.environ.get("ALBEDO_FOLDIN_ITEMS", "2000"))
+    mean_stars = float(os.environ.get("ALBEDO_FOLDIN_MEAN_STARS", "20"))
+    delta_batch = int(os.environ.get("ALBEDO_FOLDIN_DELTA_BATCH", "500"))
+    trials = int(os.environ.get("ALBEDO_FOLDIN_TRIALS", "5"))
+    rank = int(os.environ.get("ALBEDO_FOLDIN_RANK", "16"))
+    iters = int(os.environ.get("ALBEDO_FOLDIN_ITERS", "8"))
+
+    matrix = synthetic_stars(
+        n_users=n_users, n_items=n_items, rank=rank, mean_stars=mean_stars, seed=42
+    )
+    # Estimator defaults for reg/alpha; the engine's None-defaults resolve
+    # to the same values, so both arms share one hyperparameter definition.
+    est = ImplicitALS(rank=rank, max_iter=iters)
+    model = est.fit(matrix)
+    engine = FoldInEngine(model)
+    # One batch per trial (+1 warmup for each arm), deterministic.
+    batches = synthetic_delta_stream(
+        matrix, n_batches=trials + 1, batch_size=delta_batch, seed=9
+    )
+
+    def foldin_cycle(frame) -> dict:
+        overlay = StarOverlay(matrix)
+        now = float(frame["starred_at"].max())
+        t0 = time.perf_counter()
+        batch = validate_deltas(frame, matrix, now=now, policy="repair")
+        touched = overlay.apply(batch)["touched_users"]
+        rows = [overlay.user_row(du, now) for du in touched]
+        rows = [(i, v) for i, v in rows if i.size]
+        batches_before = engine.batches_run
+        f0 = time.perf_counter()
+        solved = engine.fold_in(rows)
+        foldin_s = time.perf_counter() - f0
+        cycle_s = time.perf_counter() - t0
+        if not np.isfinite(solved).all():
+            fail("foldin", "non-finite fold-in factors")
+        n_batches = engine.batches_run - batches_before
+        return {
+            "cycle_s": cycle_s,
+            "foldin_s": foldin_s,
+            "batch_s": foldin_s / max(1, n_batches),
+            "deltas_per_s": len(frame) / max(cycle_s, 1e-9),
+            "users": len(rows),
+        }
+
+    def refit_cycle(frame) -> float:
+        overlay = StarOverlay(matrix)
+        now = float(frame["starred_at"].max())
+        batch = validate_deltas(frame, matrix, now=now, policy="repair")
+        overlay.apply(batch)
+        current = overlay.materialize(now)
+        t0 = time.perf_counter()
+        est.fit(current)
+        return time.perf_counter() - t0
+
+    # Warm both arms (compiles: the fold-in shape ladder and the refit's
+    # fused fit executable for the updated-matrix layout), then interleave.
+    foldin_cycle(batches[0])
+    refit_cycle(batches[0])
+    fold_trials, refit_trials = [], []
+    for b in batches[1:]:
+        fold_trials.append(foldin_cycle(b))
+        refit_trials.append(refit_cycle(b))
+    med = lambda key: statistics.median(t[key] for t in fold_trials)  # noqa: E731
+    foldin_batch_s = med("batch_s")
+    refit_s = statistics.median(refit_trials)
+    cycle_s = med("cycle_s")
+    return {
+        "metric": "foldin_batch_latency_s",
+        "unit": "seconds per touched-user fold-in batch (median)",
+        "value": round(foldin_batch_s, 5),
+        "cycle_s_median": round(cycle_s, 4),
+        "foldin_s_median": round(med("foldin_s"), 4),
+        "deltas_per_s_median": round(med("deltas_per_s"), 1),
+        "touched_users_median": int(med("users")),
+        "full_refit_s_median": round(refit_s, 4),
+        "refit_over_foldin": round(refit_s / max(cycle_s, 1e-9), 1),
+        "trials": {
+            "foldin_cycle_s": [round(t["cycle_s"], 4) for t in fold_trials],
+            "refit_s": [round(t, 4) for t in refit_trials],
+        },
+        "n_users": n_users,
+        "n_items": n_items,
+        "delta_batch": delta_batch,
+        "rank": rank,
+    }
+
+
+SCENARIOS = {
+    "serving": serving_bench,
+    "datacheck": datacheck_bench,
+    "foldin": foldin_bench,
+}
 
 
 if __name__ == "__main__":

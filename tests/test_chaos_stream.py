@@ -1,0 +1,75 @@
+"""Streaming chaos through the real CLI: a run_stream process hard-killed
+mid-fold-in must leave NOTHING a serving watcher could promote — the served
+generation is never a half-applied delta.
+
+The publish protocol makes this structural: the pickle is written first,
+the ``.meta.json`` stamp second, and the ``.sha256`` manifest LAST — the
+reload watcher only attempts candidates whose manifest exists, so a death
+anywhere before the final rename leaves an unsealed (or absent) file no
+watcher will touch. This drill kills the process one step earlier still —
+inside the first device fold-in batch — and checks the store.
+
+Marked ``chaos`` + ``slow`` (two CLI subprocesses, each paying the jax
+import + small ALS fit); tier-1 covers the in-process fold-in/publish
+invariants in ``test_streaming_stream.py``.
+"""
+
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+pytestmark = [pytest.mark.chaos, pytest.mark.slow]
+
+
+def _env(data_dir: Path, **extra: str) -> dict:
+    env = dict(os.environ)
+    env.pop("ALBEDO_FAULTS", None)
+    env.update(
+        ALBEDO_DATA_DIR=str(data_dir),
+        ALBEDO_CHECKPOINT_DIR=str(data_dir / "checkpoints"),
+        ALBEDO_TODAY="20260803",
+        JAX_PLATFORMS="cpu",
+        **extra,
+    )
+    return env
+
+
+def _run_stream(env: dict, *extra_args: str) -> subprocess.CompletedProcess:
+    cmd = [
+        sys.executable, "-m", "albedo_tpu.cli", "run_stream", "--small",
+        "--cycles", "1", "--delta-batch", "60", "--probe-users", "40",
+        *extra_args,
+    ]
+    return subprocess.run(cmd, capture_output=True, text=True, env=env, timeout=580)
+
+
+def test_kill_mid_foldin_never_publishes_half_applied_delta(tmp_path):
+    data = tmp_path / "data"
+    env = _env(data)
+
+    # Hard-kill (os._exit(137), no cleanup) inside the first fold-in batch:
+    # after the base model trained and deltas were ingested, before any
+    # stream generation could publish.
+    killed = _run_stream({**env, "ALBEDO_FAULTS": "stream.foldin:kill@1"})
+    assert killed.returncode == 137, (killed.returncode, killed.stderr)
+
+    # The base artifact survived intact; NO stream generation exists in any
+    # state — sealed, unsealed, or stamped — so a reload watcher has nothing
+    # half-applied to even consider.
+    base = list(data.rglob("*alsModel*.pkl"))
+    assert base, "the killed run should have left its trained base artifact"
+    assert not list(data.rglob("*stream-g*")), (
+        "a killed fold-in must not leave any stream-generation file behind"
+    )
+
+    # Same store, clean rerun: the stream recovers from the intact base and
+    # publishes a SEALED generation (manifest present = watcher-visible).
+    ok = _run_stream(env)
+    assert ok.returncode == 0, ok.stderr
+    sealed = list(data.rglob("*stream-g1.pkl"))
+    assert sealed, ok.stdout
+    assert (sealed[0].parent / (sealed[0].name + ".sha256")).exists()
+    assert (sealed[0].parent / (sealed[0].name + ".meta.json")).exists()

@@ -87,5 +87,6 @@ def test_known_sites_are_present():
         "serving.source.<name>", "serving.rank",
         "serving.breaker.<name>", "reload.load", "reload.validate",
         "data.validate", "train.watchdog", "pipeline.canary",
+        "stream.ingest", "stream.foldin", "stream.drift",
     ):
         assert site in code, f"expected fault site {site!r} not found in code"

@@ -1,0 +1,362 @@
+"""Streaming delta ingest: the synthetic generator, the delta rule set
+(catalog reuse + fold-out routing + tombstones), and the StarOverlay's
+merge/decay semantics."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from albedo_tpu.datasets.star_matrix import StarMatrix
+from albedo_tpu.datasets.synthetic import synthetic_stars
+from albedo_tpu.datasets.synthetic_tables import synthetic_delta_stream
+from albedo_tpu.datasets.validate import DataValidationError, validate_starring
+from albedo_tpu.streaming.deltas import StarOverlay, validate_deltas
+from albedo_tpu.utils import events
+
+NOW = 1.6e9
+
+
+@pytest.fixture(scope="module")
+def base():
+    return synthetic_stars(n_users=200, n_items=120, rank=8, mean_stars=12, seed=5)
+
+
+def _deltas(rows):
+    return pd.DataFrame(
+        rows, columns=["user_id", "repo_id", "starred_at", "starring", "op"]
+    )
+
+
+# --- the synthetic generator --------------------------------------------------
+
+
+def test_generator_is_deterministic_and_schema_complete(base):
+    a = synthetic_delta_stream(base, n_batches=3, batch_size=100, seed=9)
+    b = synthetic_delta_stream(base, n_batches=3, batch_size=100, seed=9)
+    assert len(a) == 3
+    for fa, fb in zip(a, b):
+        pd.testing.assert_frame_equal(fa, fb)
+        assert list(fa.columns) == ["user_id", "repo_id", "starred_at", "starring", "op"]
+        assert set(fa["op"]) <= {"star", "unstar"}
+        assert len(fa) == 100
+
+
+def test_generator_emits_every_delta_class(base):
+    (batch,) = synthetic_delta_stream(
+        base, n_batches=1, batch_size=200, seed=3,
+        frac_unstar=0.1, frac_new_user=0.05, frac_new_repo=0.05,
+    )
+    du = base.users_of(batch["user_id"].to_numpy(np.int64))
+    di = base.items_of(batch["repo_id"].to_numpy(np.int64))
+    star = (batch["op"] == "star").to_numpy()
+    assert (~star).sum() == 20  # un-stars
+    assert ((du < 0) & star).sum() == 10  # new users
+    assert ((di < 0) & star).sum() == 10  # new repos
+    # Un-stars tombstone pairs that actually exist in the base matrix.
+    keys = base.rows.astype(np.int64) * base.n_items + base.cols
+    un = ~star
+    un_keys = du[un].astype(np.int64) * base.n_items + di[un]
+    assert np.isin(un_keys, keys).all()
+
+
+def test_generator_timestamps_are_monotone_across_batches(base):
+    batches = synthetic_delta_stream(base, n_batches=3, batch_size=50, seed=1)
+    maxima = [float(b["starred_at"].max()) for b in batches]
+    minima = [float(b["starred_at"].min()) for b in batches]
+    assert maxima[0] < minima[1] < maxima[1] < minima[2]
+    for b in batches:
+        assert b["starred_at"].is_monotonic_increasing
+
+
+def test_generator_new_stars_follow_popularity(base):
+    """Power-law shape: the top-popularity third of the catalog should soak
+    up well over its uniform share of fresh stars."""
+    (batch,) = synthetic_delta_stream(
+        base, n_batches=1, batch_size=600, seed=11,
+        frac_unstar=0.0, frac_new_user=0.0, frac_new_repo=0.0,
+    )
+    di = base.items_of(batch["repo_id"].to_numpy(np.int64))
+    counts = base.item_counts()
+    top_third = set(np.argsort(-counts)[: base.n_items // 3].tolist())
+    frac = np.mean([int(i) in top_third for i in di])
+    assert frac > 0.55  # uniform would be ~0.33
+
+
+# --- validate_deltas ----------------------------------------------------------
+
+
+def test_unknown_entities_route_to_fold_out_not_violations(base):
+    deltas = _deltas([
+        (int(base.user_ids[0]), int(base.item_ids[1]), NOW, 1.0, "star"),
+        (99_999_999, int(base.item_ids[0]), NOW, 1.0, "star"),  # new user
+        (int(base.user_ids[0]), 88_888_888, NOW, 1.0, "star"),  # new repo
+    ])
+    batch = validate_deltas(deltas, base, now=NOW, policy="repair")
+    assert batch.n_rows == 1
+    assert batch.n_fold_out == 2
+    assert batch.report.violations == {}
+    assert events.stream_deltas.value(kind="folded_out") == 2
+
+
+def test_dangling_tombstone_is_a_violation(base):
+    deltas = _deltas([
+        (99_999_999, int(base.item_ids[0]), NOW, 1.0, "unstar"),
+    ])
+    batch = validate_deltas(deltas, base, now=NOW, policy="repair")
+    assert batch.n_rows == 0
+    assert batch.n_fold_out == 0
+    assert batch.report.violations == {"dangling_tombstone": 1}
+    with pytest.raises(DataValidationError):
+        validate_deltas(deltas, base, now=NOW, policy="strict")
+
+
+def test_catalog_rules_apply_to_delta_rows(base):
+    u, r = int(base.user_ids[0]), int(base.item_ids[0])
+    deltas = _deltas([
+        (u, r, NOW, -1.0, "star"),            # nonpositive confidence
+        (u, int(base.item_ids[1]), NOW * 9, 1.0, "star"),  # far future
+        (u, int(base.item_ids[2]), NOW, 1.0, "star"),      # clean
+    ])
+    batch = validate_deltas(deltas, base, now=NOW, policy="repair")
+    assert batch.report.violations.get("nonpositive_confidence") == 1
+    assert batch.report.violations.get("timestamp_range") == 1
+    assert batch.n_rows == 1
+
+
+def test_cross_op_keep_last_resolves_star_then_unstar(base):
+    """A pair starred then un-starred inside one batch must leave only the
+    tombstone (the catalog's duplicate keep-last runs across ops)."""
+    u, r = int(base.user_ids[3]), int(base.item_ids[3])
+    deltas = _deltas([
+        (u, r, NOW + 1, 1.0, "star"),
+        (u, r, NOW + 2, 1.0, "unstar"),
+    ])
+    batch = validate_deltas(deltas, base, now=NOW + 10, policy="repair")
+    assert batch.n_rows == 1
+    assert batch.frame.iloc[0]["op"] == "unstar"
+    # Resolution is the stream's normal mechanics, not corruption: strict
+    # must NOT die on superseded rows (they count, but don't raise).
+    strict = validate_deltas(deltas, base, now=NOW + 10, policy="strict")
+    assert strict.n_rows == 1
+    assert strict.frame.iloc[0]["op"] == "unstar"
+    assert strict.report.violations.get("duplicate_pair") == 1
+
+
+def test_unparseable_ids_are_invalid_not_fold_out(base):
+    """The conformer's -1 sentinel is not an identity: corrupt-id rows must
+    be dropped as `invalid_id`, never queued for a refit to train a phantom
+    id -1 user on."""
+    import pandas as pd
+
+    deltas = pd.DataFrame({
+        "user_id": ["not-a-number", str(int(base.user_ids[0]))],
+        "repo_id": [str(int(base.item_ids[0])), str(int(base.item_ids[1]))],
+        "starred_at": [NOW, NOW],
+        "starring": [1.0, 1.0],
+        "op": ["star", "star"],
+    })
+    batch = validate_deltas(deltas, base, now=NOW, policy="repair")
+    assert batch.n_rows == 1
+    assert batch.n_fold_out == 0
+    assert batch.report.violations.get("invalid_id") == 1
+    with pytest.raises(DataValidationError):
+        validate_deltas(deltas, base, now=NOW, policy="strict")
+
+
+def test_fold_out_rows_still_face_the_non_vocab_rules(base):
+    """A violating row must fail at the ingest that saw it, not cycles later
+    inside a refit's strict ingest: fold-out routing skips only the vocab
+    rules, never confidence/timestamp."""
+    deltas = _deltas([
+        (99_999_999, int(base.item_ids[0]), NOW, -1.0, "star"),  # unknown user, bad conf
+        (77_777_777, int(base.item_ids[1]), NOW, 1.0, "star"),   # unknown user, clean
+    ])
+    batch = validate_deltas(deltas, base, now=NOW, policy="repair")
+    assert batch.n_fold_out == 1  # only the clean row queues
+    assert batch.report.violations.get("nonpositive_confidence") == 1
+    with pytest.raises(DataValidationError):
+        validate_deltas(deltas, base, now=NOW, policy="strict")
+
+
+def test_off_policy_still_routes_fold_out(base):
+    deltas = _deltas([
+        (99_999_999, int(base.item_ids[0]), NOW, 1.0, "star"),
+        (int(base.user_ids[0]), int(base.item_ids[0]), NOW * 9, 1.0, "star"),
+    ])
+    batch = validate_deltas(deltas, base, now=NOW, policy="off")
+    # Fold-out is physics (frozen vocabularies), not policy; the catalog
+    # rules are policy and stay off.
+    assert batch.n_fold_out == 1
+    assert batch.n_rows == 1
+    assert batch.report.violations == {}
+
+
+def test_tombstone_starring_value_never_trips_confidence_rule(base):
+    u = int(base.user_ids[0])
+    r = int(base.item_ids[base.cols[base.rows == 0][0]])
+    deltas = _deltas([(u, r, NOW, 0.0, "unstar")])
+    batch = validate_deltas(deltas, base, now=NOW, policy="repair")
+    assert batch.n_rows == 1
+    assert "nonpositive_confidence" not in batch.report.violations
+
+
+# --- the timestamp_range `now` satellite --------------------------------------
+
+
+def test_validate_starring_without_now_uses_wall_clock():
+    """The future-skew rule must fire even when the caller forgot `now` —
+    it used to be silently skipped, so year-3000 rows validated clean."""
+    frame = pd.DataFrame({
+        "user_id": [1, 2],
+        "repo_id": [10, 20],
+        "starred_at": [1.5e9, 32_503_680_000.0],  # ~year 3000
+        "starring": [1.0, 1.0],
+    })
+    clean, report = validate_starring(frame, policy="repair")
+    assert report.violations.get("timestamp_range") == 1
+    assert len(clean) == 1
+
+
+def test_validate_starring_explicit_now_is_deterministic():
+    frame = pd.DataFrame({
+        "user_id": [1], "repo_id": [10],
+        "starred_at": [NOW + 3 * 86_400.0], "starring": [1.0],
+    })
+    # Replayed "in the past": the row is future-skewed relative to NOW...
+    _, report = validate_starring(frame, policy="repair", now=NOW)
+    assert report.violations.get("timestamp_range") == 1
+    # ...and clean relative to a later replay clock. Same frame, same
+    # verdicts for the same `now` — never wall-clock-dependent.
+    _, report2 = validate_starring(frame, policy="repair", now=NOW + 4 * 86_400.0)
+    assert report2.violations == {}
+
+
+# --- StarOverlay --------------------------------------------------------------
+
+
+def _apply(base, rows, now=NOW, **overlay_kw):
+    overlay = StarOverlay(base, **overlay_kw)
+    batch = validate_deltas(_deltas(rows), base, now=now, policy="repair")
+    report = overlay.apply(batch)
+    return overlay, report
+
+
+def test_overlay_apply_star_and_tombstone(base):
+    u_new = int(base.user_ids[7])
+    # An item this user has NOT starred:
+    seen = set(base.cols[base.rows == 7].tolist())
+    i_new = next(i for i in range(base.n_items) if i not in seen)
+    # An existing pair to tombstone:
+    u_t, i_t = int(base.rows[0]), int(base.cols[0])
+    overlay, report = _apply(base, [
+        (u_new, int(base.item_ids[i_new]), NOW, 1.0, "star"),
+        (int(base.user_ids[u_t]), int(base.item_ids[i_t]), NOW, 1.0, "unstar"),
+    ])
+    assert report["applied"] == 1 and report["tombstoned"] == 1
+    assert overlay.has_pair(7, i_new)
+    assert not overlay.has_pair(u_t, i_t)
+    mat = overlay.materialize(NOW)
+    assert mat.nnz == base.nnz  # one added, one removed
+    dense = mat.dense()
+    assert dense[7, i_new] > 1.0  # fresh star carries the recency boost
+    assert dense[u_t, i_t] == 0.0
+
+
+def test_overlay_unstar_of_overlay_star_restores_absence(base):
+    u = int(base.user_ids[2])
+    seen = set(base.cols[base.rows == 2].tolist())
+    i = next(i for i in range(base.n_items) if i not in seen)
+    r = int(base.item_ids[i])
+    overlay, _ = _apply(base, [(u, r, NOW, 1.0, "star")])
+    batch = validate_deltas(
+        _deltas([(u, r, NOW + 1, 1.0, "unstar")]), base, now=NOW + 1, policy="repair"
+    )
+    report = overlay.apply(batch)
+    assert report["tombstoned"] == 1
+    assert not overlay.has_pair(2, i)
+    assert overlay.materialize(NOW + 1).nnz == base.nnz
+    # A second tombstone of the now-absent pair is dangling.
+    batch2 = validate_deltas(
+        _deltas([(u, r, NOW + 2, 1.0, "unstar")]), base, now=NOW + 2, policy="repair"
+    )
+    report2 = overlay.apply(batch2)
+    assert report2["dangling_tombstones"] == 1
+
+
+def test_overlay_confidence_decays_toward_base_weight(base):
+    overlay = StarOverlay(base, half_life_s=86_400.0, recency_boost=1.0)
+    fresh = overlay.confidence(NOW, NOW)
+    day_old = overlay.confidence(NOW - 86_400.0, NOW)
+    month_old = overlay.confidence(NOW - 30 * 86_400.0, NOW)
+    assert fresh == pytest.approx(2.0)
+    assert day_old == pytest.approx(1.5)
+    assert 1.0 < month_old < 1.01
+    assert fresh > day_old > month_old
+
+
+def test_overlay_user_row_matches_materialized_row(base):
+    """The fold-in parity anchor: user_row and materialize share one merge."""
+    batches = synthetic_delta_stream(base, n_batches=2, batch_size=150, seed=2)
+    overlay = StarOverlay(base)
+    now = NOW
+    touched: set[int] = set()
+    for frame in batches:
+        now = float(frame["starred_at"].max())
+        batch = validate_deltas(frame, base, now=now, policy="repair")
+        touched.update(overlay.apply(batch)["touched_users"])
+    mat = overlay.materialize(now)
+    indptr, cols, vals = mat.csr()
+    assert touched
+    for du in sorted(touched):
+        idx, val = overlay.user_row(du, now)
+        mc = cols[indptr[du]:indptr[du + 1]]
+        mv = vals[indptr[du]:indptr[du + 1]]
+        o_row, o_mat = np.argsort(idx), np.argsort(mc)
+        assert np.array_equal(idx[o_row], mc[o_mat])
+        np.testing.assert_allclose(val[o_row], mv[o_mat], rtol=1e-6)
+
+
+def test_overlay_materialize_keeps_vocabularies(base):
+    overlay, _ = _apply(base, [
+        (int(base.user_ids[0]), int(base.item_ids[1]), NOW, 1.0, "star"),
+    ])
+    mat = overlay.materialize(NOW)
+    assert np.array_equal(mat.user_ids, base.user_ids)
+    assert np.array_equal(mat.item_ids, base.item_ids)
+    assert isinstance(mat, StarMatrix)
+
+
+def test_overlay_updated_starring_for_refit(base):
+    star_frame = pd.DataFrame({
+        "user_id": base.user_ids[base.rows].astype(np.int64),
+        "repo_id": base.item_ids[base.cols].astype(np.int64),
+        "starred_at": np.full(base.nnz, 1.5e9),
+        "starring": np.ones(base.nnz),
+    })
+    u_t, i_t = int(base.rows[0]), int(base.cols[0])
+    u_new = int(base.user_ids[9])
+    seen = set(base.cols[base.rows == 9].tolist())
+    i_new = next(i for i in range(base.n_items) if i not in seen)
+    overlay, _ = _apply(base, [
+        (u_new, int(base.item_ids[i_new]), NOW, 1.0, "star"),
+        (int(base.user_ids[u_t]), int(base.item_ids[i_t]), NOW, 1.0, "unstar"),
+    ])
+    fold_out = _deltas([(424242, 525252, NOW, 1.0, "star")])[
+        ["user_id", "repo_id", "starred_at", "starring", "op"]
+    ]
+    updated = overlay.updated_starring(star_frame, fold_out=fold_out)
+    # One tombstoned row gone, one overlay star added, one fold-out row added.
+    assert len(updated) == base.nnz + 1
+    keys = set(zip(updated["user_id"], updated["repo_id"]))
+    assert (int(base.user_ids[u_t]), int(base.item_ids[i_t])) not in keys
+    assert (u_new, int(base.item_ids[i_new])) in keys
+    assert (424242, 525252) in keys
+
+
+def test_stream_ingest_fault_site_fires(base):
+    from albedo_tpu.utils import faults
+    from albedo_tpu.utils.faults import FaultInjected
+
+    faults.site("stream.ingest").arm(kind="error")
+    with pytest.raises(FaultInjected):
+        validate_deltas(_deltas([]), base, now=NOW, policy="repair")

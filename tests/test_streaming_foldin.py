@@ -1,0 +1,184 @@
+"""Fold-in engine: parity with the full-refit user solve (the property the
+whole streaming subsystem hangs on), shape-ladder executable reuse, and the
+watchdog guard's detect -> remediate -> refuse path."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from albedo_tpu.datasets.synthetic import synthetic_stars  # noqa: E402
+from albedo_tpu.datasets.synthetic_tables import synthetic_delta_stream  # noqa: E402
+from albedo_tpu.models.als import ImplicitALS  # noqa: E402
+from albedo_tpu.streaming.deltas import StarOverlay, validate_deltas  # noqa: E402
+from albedo_tpu.streaming.foldin import FoldInDiverged, FoldInEngine  # noqa: E402
+from albedo_tpu.utils import events, faults  # noqa: E402
+
+REG, ALPHA = 0.5, 40.0
+
+
+@pytest.fixture(scope="module")
+def trained():
+    matrix = synthetic_stars(n_users=150, n_items=100, rank=8, mean_stars=10, seed=4)
+    model = ImplicitALS(rank=8, reg_param=REG, alpha=ALPHA, max_iter=4).fit(matrix)
+    return matrix, model
+
+
+def _reference_solve(item_factors, idx, val, reg=REG, alpha=ALPHA):
+    """Float64 normal-equation solve — the implicit-ALS user half-sweep a
+    full refit runs for this row given the same (frozen) item factors."""
+    Y = np.asarray(item_factors, np.float64)[idx]
+    yty = np.asarray(item_factors, np.float64).T @ np.asarray(item_factors, np.float64)
+    c1 = alpha * np.asarray(val, np.float64)
+    a = yty + (Y * c1[:, None]).T @ Y + reg * len(idx) * np.eye(Y.shape[1])
+    b = Y.T @ (1.0 + c1)
+    return np.linalg.solve(a, b)
+
+
+def test_foldin_matches_full_refit_solve_over_random_deltas(trained):
+    """The satellite property test: fold-in factors == full-refit factors
+    (within float32-vs-float64 tolerance) when item factors are unchanged,
+    over random delta streams."""
+    matrix, model = trained
+    for seed in (1, 2, 3):
+        overlay = StarOverlay(matrix)
+        (frame,) = synthetic_delta_stream(
+            matrix, n_batches=1, batch_size=120, seed=seed,
+        )
+        now = float(frame["starred_at"].max())
+        batch = validate_deltas(frame, matrix, now=now, policy="repair")
+        touched = overlay.apply(batch)["touched_users"]
+        rows = []
+        keep = []
+        for du in touched:
+            idx, val = overlay.user_row(du, now)
+            if idx.size:
+                rows.append((idx, val))
+                keep.append(du)
+        assert rows, "delta stream touched nobody"
+        engine = FoldInEngine(model, reg_param=REG, alpha=ALPHA, max_batch=16)
+        solved = engine.fold_in(rows)
+        for j, (idx, val) in enumerate(rows):
+            ref = _reference_solve(model.item_factors, idx, val)
+            np.testing.assert_allclose(solved[j], ref, rtol=2e-3, atol=2e-4)
+
+
+def test_foldin_matches_training_kernel_exactly(trained):
+    """Cross-check against the actual training op (``bucket_solve_body``)
+    on the same padded rows — fold-in IS the training solve, so this is
+    near-bitwise (same program, same shapes)."""
+    import jax.numpy as jnp
+
+    from albedo_tpu.ops.als import bucket_solve_body, gramian
+
+    matrix, model = trained
+    overlay = StarOverlay(matrix)
+    (frame,) = synthetic_delta_stream(matrix, n_batches=1, batch_size=60, seed=8)
+    now = float(frame["starred_at"].max())
+    touched = overlay.apply(
+        validate_deltas(frame, matrix, now=now, policy="repair")
+    )["touched_users"]
+    rows = [overlay.user_row(du, now) for du in touched]
+    rows = [(i, v) for i, v in rows if i.size][:8]
+    engine = FoldInEngine(model, reg_param=REG, alpha=ALPHA, max_batch=8)
+    solved = engine.fold_in(rows)
+
+    length = max(int(i.size) for i, _ in rows)
+    length = 1 << (length - 1).bit_length()
+    idx = np.zeros((8, length), np.int32)
+    val = np.zeros((8, length), np.float32)
+    mask = np.zeros((8, length), bool)
+    for r, (ri, rv) in enumerate(rows):
+        idx[r, : ri.size] = ri
+        val[r, : ri.size] = rv
+        mask[r, : ri.size] = True
+    vf = jnp.asarray(model.item_factors)
+    direct = np.asarray(bucket_solve_body(
+        vf, gramian(vf), idx, val, mask, jnp.float32(REG), jnp.float32(ALPHA)
+    ))[: len(rows)]
+    np.testing.assert_allclose(solved, direct, rtol=1e-6, atol=1e-7)
+
+
+def test_foldin_shape_ladder_reuses_executables(trained):
+    _, model = trained
+    engine = FoldInEngine(model, max_batch=8)
+    rng = np.random.default_rng(0)
+
+    def row(n):
+        return (
+            rng.choice(model.item_factors.shape[0], size=n, replace=False).astype(np.int32),
+            np.ones(n, np.float32),
+        )
+
+    engine.fold_in([row(3), row(5)])   # (2->2, len 8) bucket... pow2(2)=2
+    n_after_first = len(engine._executables)
+    engine.fold_in([row(4), row(6)])   # same pow2 shape: no new executable
+    assert len(engine._executables) == n_after_first
+    engine.fold_in([row(30)])          # longer row: one new shape
+    assert len(engine._executables) == n_after_first + 1
+    assert engine.batches_run == 3
+
+
+def test_foldin_rejects_empty_rows(trained):
+    _, model = trained
+    engine = FoldInEngine(model)
+    with pytest.raises(ValueError, match="empty user row"):
+        engine.fold_in([(np.zeros(0, np.int32), np.zeros(0, np.float32))])
+
+
+def test_foldin_watchdog_remediates_injected_nan(trained):
+    """The stream.foldin error kind scribbles NaN into the solved batch —
+    the watchdog must catch it, re-solve damped, and return finite rows
+    (the train.watchdog chaos convention)."""
+    _, model = trained
+    engine = FoldInEngine(model, reg_param=REG, alpha=ALPHA)
+    faults.site("stream.foldin").arm(kind="error")
+    rng = np.random.default_rng(1)
+    rows = [(
+        rng.choice(model.item_factors.shape[0], size=5, replace=False).astype(np.int32),
+        np.ones(5, np.float32),
+    )]
+    solved = engine.fold_in(rows)
+    assert np.isfinite(solved).all()
+    assert engine.trips == 1
+    assert events.watchdog_trips.value(kind="foldin") == 1
+
+
+def test_foldin_diverged_raises_after_failed_remediation(trained, monkeypatch):
+    """A batch that stays sick after the damped re-solve must refuse to fold
+    in (the cycle fails, nothing publishes)."""
+    _, model = trained
+    engine = FoldInEngine(model)
+    import albedo_tpu.streaming.foldin as foldin_mod
+
+    def always_sick(uf, vf):
+        return np.array([1.0, 0.0, 0.0], np.float32)  # nonfinite count > 0
+
+    monkeypatch.setattr(
+        "albedo_tpu.utils.watchdog.factor_health", always_sick
+    )
+    rng = np.random.default_rng(2)
+    rows = [(
+        rng.choice(model.item_factors.shape[0], size=4, replace=False).astype(np.int32),
+        np.ones(4, np.float32),
+    )]
+    with pytest.raises(FoldInDiverged):
+        engine.fold_in(rows)
+    assert foldin_mod  # silence unused-import linters
+
+
+def test_foldin_splits_oversized_batches(trained):
+    _, model = trained
+    engine = FoldInEngine(model, max_batch=4)
+    rng = np.random.default_rng(3)
+    rows = [
+        (
+            rng.choice(model.item_factors.shape[0], size=3, replace=False).astype(np.int32),
+            np.ones(3, np.float32),
+        )
+        for _ in range(10)
+    ]
+    solved = engine.fold_in(rows)
+    assert solved.shape == (10, model.rank)
+    assert engine.batches_run == 3  # 4 + 4 + 2
+    assert engine.users_solved == 10

@@ -1,0 +1,327 @@
+"""The streaming loop end to end: drift monitor verdicts, the run_stream
+journal/publish/lineage contract, hot-swap promotion of incremental
+generations through the real reload gates, the forced-drift exactly-one-
+refit drill, and the fold-in-vs-refit quality bound."""
+
+import argparse
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from albedo_tpu.builders.jobs import JobContext  # noqa: E402
+from albedo_tpu.datasets import artifacts as store  # noqa: E402
+from albedo_tpu.datasets import synthetic_tables  # noqa: E402
+from albedo_tpu.datasets.split import sample_test_users  # noqa: E402
+from albedo_tpu.streaming.drift import DriftMonitor, probe_score  # noqa: E402
+from albedo_tpu.streaming.job import JOURNAL_NAME, run_stream  # noqa: E402
+from albedo_tpu.utils import events, faults  # noqa: E402
+
+pytestmark = pytest.mark.filterwarnings("ignore::DeprecationWarning")
+
+
+def make_ctx(tag="streamtest", **args_over):
+    ns = argparse.Namespace(
+        small=True, tables=None, now=1700000000.0, no_compilation_cache=True,
+        data_policy=None, solver="cholesky", cg_steps=3, checkpoint_every=0,
+        resume=False, keep_last=3, _rest=[],
+        **args_over,
+    )
+    tables = synthetic_tables(n_users=120, n_items=80, mean_stars=10, seed=11)
+    return JobContext(ns, tables=tables, tag=tag), ns
+
+
+def _opts(**over):
+    base = dict(
+        cycles=2, delta_batch=80, stream_seed=7, deltas="",
+        drift_tolerance=0.05, drift_floor=0.0, drift_every=1,
+        half_life_days=7.0, recency_boost=1.0, foldout_limit=0,
+        max_foldin_batch=16, probe_users=40, no_publish=False,
+        keep_stream=3, refit_checkpoint_every=2,
+    )
+    base.update(over)
+    return argparse.Namespace(**base)
+
+
+# --- drift monitor ------------------------------------------------------------
+
+
+class TestDriftMonitor:
+    @pytest.fixture(scope="class")
+    def fitted(self):
+        from albedo_tpu.datasets.synthetic import synthetic_stars
+        from albedo_tpu.models.als import ImplicitALS
+
+        matrix = synthetic_stars(n_users=150, n_items=100, rank=8, mean_stars=10, seed=6)
+        model = ImplicitALS(rank=8, max_iter=4).fit(matrix)
+        probe = sample_test_users(matrix, n=40)
+        return matrix, model, probe
+
+    def test_healthy_model_does_not_drift(self, fitted):
+        matrix, model, probe = fitted
+        score = probe_score(model, matrix, probe)
+        monitor = DriftMonitor(baseline=score, tolerance=0.05)
+        verdict = monitor.check(model, matrix, probe)
+        assert not verdict["drifted"]
+        assert verdict["score"] == pytest.approx(score, abs=1e-6)
+
+    def test_decay_past_tolerance_drifts(self, fitted):
+        matrix, model, probe = fitted
+        score = probe_score(model, matrix, probe)
+        monitor = DriftMonitor(baseline=score * 2.0, tolerance=0.05)
+        verdict = monitor.check(model, matrix, probe)
+        assert verdict["drifted"]
+        assert "decayed" in verdict["reasons"][0]
+
+    def test_floor_drifts_and_rebase_resets(self, fitted):
+        matrix, model, probe = fitted
+        monitor = DriftMonitor(baseline=None, tolerance=0.05, floor=2.0)
+        verdict = monitor.check(model, matrix, probe)
+        assert verdict["drifted"]
+        monitor.rebase(0.9)
+        assert monitor.baseline == 0.9
+        assert monitor.refits == 1
+        assert monitor.baseline_source == "refit"
+
+    def test_drift_fault_site_fires(self, fitted):
+        from albedo_tpu.utils.faults import FaultInjected
+
+        matrix, model, probe = fitted
+        monitor = DriftMonitor(baseline=None)
+        faults.site("stream.drift").arm(kind="error")
+        with pytest.raises(FaultInjected):
+            monitor.check(model, matrix, probe)
+
+
+# --- the end-to-end loop ------------------------------------------------------
+
+
+def test_run_stream_end_to_end_publishes_hot_swappable_generations():
+    """The acceptance drill's fast half: synthetic deltas -> validated
+    ingest -> fold-in -> stamped publish, then a live HotSwapManager
+    promotes the newest stream generation through the real gates, and the
+    served factors ARE the folded factors."""
+    from albedo_tpu.serving.reload import HotSwapManager
+    from albedo_tpu.serving.service import RecommendationService
+
+    ctx, ns = make_ctx()
+    opts = _opts(cycles=2)
+    journal = run_stream(ctx, ns, opts)
+
+    assert journal["status"] == "complete"
+    s = journal["summary"]
+    assert s["cycles"] == 2 and s["publishes"] == 2 and s["refits"] == 0
+    assert s["deltas_applied"] > 0
+    for cycle in journal["cycles"]:
+        assert cycle["status"] == "done"
+        assert cycle["cycle_s"] < 60.0  # the acceptance bound, on tiny data
+        assert not cycle["drift"]["drifted"]
+
+    # Journal is on disk; the published generations are sealed + stamped.
+    disk = store.artifact_path(ctx.artifact_name(JOURNAL_NAME))
+    assert disk.exists()
+    g2 = store.artifact_path(
+        ctx.artifact_name(f"{ctx.als_key()}-stream-g2.pkl")
+    )
+    assert g2.exists() and store.verify_manifest(g2) is True
+    meta = store.read_meta(g2)
+    assert meta["canary"]["passed"] is True
+    assert meta["canary"]["source"] == "drift_check"  # measured this cycle
+    lineage = meta["lineage"]
+    assert lineage["stream_generation"] == 2
+    assert lineage["delta_count"] == s["deltas_applied"]
+    assert lineage["base_artifact"] == ctx.als_artifact_name()
+    base_sha = store.read_manifest_sha(store.artifact_path(ctx.als_artifact_name()))
+    assert lineage["base_sha256"] == base_sha
+
+    # Hot-swap through the REAL reload gates: manifest, stamp, load,
+    # invariants (shapes frozen by design), probe, post-swap parity.
+    with RecommendationService(ctx.als_model(), ctx.matrix()) as service:
+        manager = HotSwapManager(
+            service, artifact_glob=f"{ctx.tag}-alsModel-*stream-g*.pkl"
+        )
+        report = manager.request_reload()
+        assert report["outcome"] == "promoted", report
+        served = service.generation.model.user_factors
+        published = np.asarray(
+            store.load_pickle(g2)["user_factors"], dtype=np.float32
+        )
+        assert np.array_equal(served, published)
+        # Folded rows actually differ from the base model (the swap moved
+        # the served state forward, not sideways).
+        assert not np.array_equal(served, ctx.als_model().user_factors)
+
+
+def test_forced_drift_triggers_exactly_one_checkpointed_refit():
+    """The acceptance drill's slow half: a drift verdict past tolerance
+    schedules ONE full checkpointed refit (journaled, counted), the stream
+    rebases on it, and the fold-out queue is absorbed."""
+    from albedo_tpu.settings import get_settings
+
+    ctx, ns = make_ctx(tag="streamrefit")
+    opts = _opts(cycles=2, drift_floor=1.0, drift_every=2, delta_batch=60)
+    journal = run_stream(ctx, ns, opts)
+
+    assert journal["summary"]["refits"] == 1
+    assert events.drift_refits.total() == 1
+    refit = journal["cycles"][-1]["refit"]
+    assert refit["journal_status"] == "partial"  # ingest/train_als/canary subset
+    assert refit["canary_score"] > 0
+    assert "below the absolute floor" in refit["reasons"][0]
+    # The refit absorbed the fold-out queue: vocabulary grew past the base.
+    assert refit["n_users"] >= ctx.matrix().n_users
+    assert journal["summary"]["fold_out_rows"] == 0
+    # It really checkpointed (preemption-safe machinery engaged).
+    steps = list(get_settings().checkpoint_dir.rglob("step_*"))
+    assert steps, "refit left no checkpoint steps"
+    # The refit's own pipeline journal + canary stamp exist.
+    refit_meta = store.read_meta(store.artifact_path(refit["artifact"]))
+    assert refit_meta is not None
+    assert refit_meta["canary"]["score"] == pytest.approx(refit["canary_score"])
+    # Publishes after the rebase stamp the refit artifact as lineage base,
+    # with delta_count RESET — everything folded so far is inside the refit.
+    last_pub = journal["cycles"][-1]["publish"]
+    pub_meta = store.read_meta(store.artifact_path(last_pub["artifact"]))
+    assert pub_meta["lineage"]["base_artifact"] == refit["artifact"]
+    assert pub_meta["lineage"]["delta_count"] == 0
+    # ...while the run-total summary still counts every applied delta.
+    assert journal["summary"]["deltas_applied"] > 0
+
+
+def test_foldin_quality_within_five_percent_of_full_refit():
+    """Acceptance bound: fold-in NDCG@30 on the probe slice within 5% of a
+    full refit trained on the SAME materialized data."""
+    from albedo_tpu.models.als import ALSModel, ImplicitALS
+    from albedo_tpu.streaming.deltas import StarOverlay, validate_deltas
+
+    ctx, _ = make_ctx(tag="streamparity")
+    matrix = ctx.matrix()
+    model = ctx.als_model()
+    from albedo_tpu.datasets.synthetic_tables import synthetic_delta_stream
+
+    overlay = StarOverlay(matrix)
+    batches = synthetic_delta_stream(
+        matrix, n_batches=2, batch_size=60, seed=13,
+        frac_new_user=0.0, frac_new_repo=0.0,
+    )
+    now = 0.0
+    uf = np.array(model.user_factors, copy=True)
+    from albedo_tpu.streaming.foldin import FoldInEngine
+
+    engine = FoldInEngine(model, reg_param=0.5, alpha=40.0)
+    for frame in batches:
+        now = float(frame["starred_at"].max())
+        touched = overlay.apply(
+            validate_deltas(frame, matrix, now=now, policy="repair")
+        )["touched_users"]
+        rows = [(du, *overlay.user_row(du, now)) for du in touched]
+        rows = [(du, i, v) for du, i, v in rows if i.size]
+        if rows:
+            solved = engine.fold_in([(i, v) for _, i, v in rows])
+            uf[np.asarray([du for du, _, _ in rows])] = solved
+
+    current = overlay.materialize(now)
+    probe = ctx.test_user_dense(40)
+    folded = ALSModel(uf, model.item_factors, rank=model.rank)
+    fold_score = probe_score(folded, current, probe)
+    refit = ImplicitALS(rank=16, max_iter=8).fit(current)
+    refit_score = probe_score(refit, current, probe)
+    assert fold_score >= refit_score * 0.95, (fold_score, refit_score)
+
+
+def test_run_stream_counts_metrics_and_quarantines():
+    ctx, ns = make_ctx(tag="streammetrics")
+    journal = run_stream(ctx, ns, _opts(cycles=1))
+    assert events.stream_publishes.value(outcome="published") == 1
+    assert events.foldin_users.total() > 0
+    applied = events.stream_deltas.value(kind="applied")
+    assert applied == journal["cycles"][0]["ingest"]["applied"]
+    assert events.stream_deltas.value(kind="folded_out") == (
+        journal["cycles"][0]["ingest"]["fold_out"]
+    )
+
+
+def test_run_stream_retention_prunes_old_generations():
+    ctx, ns = make_ctx(tag="streamkeep")
+    run_stream(ctx, ns, _opts(cycles=3, keep_stream=2, drift_every=99))
+    names = sorted(
+        p.name for p in store.get_settings().artifact_dir.glob(
+            f"{ctx.tag}-*stream-g*.pkl"
+        )
+    )
+    assert names == [
+        ctx.artifact_name(f"{ctx.als_key()}-stream-g2.pkl"),
+        ctx.artifact_name(f"{ctx.als_key()}-stream-g3.pkl"),
+    ]
+    # No drift check ran inside the --drift-every window: the stamp must say
+    # the score is inherited, not measured on these folded factors.
+    meta = store.read_meta(store.artifact_path(names[-1]))
+    assert meta["canary"]["source"] == "inherited"
+
+
+def test_delta_files_every_file_is_a_cycle_and_clock_survives_junk(tmp_path):
+    """--deltas processes EVERY file (no silent --cycles truncation), in
+    CHRONOLOGICAL order (batch max timestamp, not file name — lexicographic
+    replay would let an old star overwrite a newer tombstone), and a file
+    missing starred_at neither crashes the stream clock nor poisons it with
+    NaN — those rows just fail timestamp_range in repair."""
+    from albedo_tpu.datasets.synthetic_tables import synthetic_delta_stream
+
+    ctx, ns = make_ctx(tag="streamfiles")
+    frames = synthetic_delta_stream(
+        ctx.matrix(), n_batches=3, batch_size=40, seed=5,
+        start_at=ctx.tables().starring["starred_at"].max() + 60.0,
+    )
+    sizes = []
+    # Chronologically-FIRST batch gets the lexicographically-LAST name.
+    for name, frame in zip(("zz-first.csv", "batch-001.csv", "batch-002.csv"), frames):
+        frame.iloc[: 10 + 10 * len(sizes)].to_csv(tmp_path / name, index=False)
+        sizes.append(10 + 10 * len(sizes))
+    # A fourth, degenerate file: no starred_at column at all (sorts last).
+    frames[0].drop(columns=["starred_at"]).to_csv(
+        tmp_path / "aaa-no-ts.csv", index=False
+    )
+    journal = run_stream(
+        ctx, ns,
+        _opts(cycles=1, deltas=str(tmp_path), drift_every=99, no_publish=True),
+    )
+    assert journal["status"] == "complete"
+    assert journal["summary"]["cycles"] == 4  # every file, not --cycles
+    # Chronological replay: distinct per-batch sizes identify the order.
+    assert [c["ingest"]["rows_in"] for c in journal["cycles"][:3]] == sizes
+    last = journal["cycles"][-1]["ingest"]
+    assert last["applied"] == 0  # all rows failed timestamp_range under repair
+    assert last["violations"].get("timestamp_range", 0) > 0
+
+
+def test_failed_cycle_lands_in_the_journal(monkeypatch):
+    """Exit-code triage needs journal evidence: a cycle that dies (here a
+    fold-in divergence) must be journaled as failed with the error, and the
+    on-disk journal status must not be left 'running'."""
+    import json
+
+    from albedo_tpu.streaming.foldin import FoldInDiverged, FoldInEngine
+
+    def boom(self, rows):
+        raise FoldInDiverged(len(rows), {"nonfinite": 1, "max_abs": 0.0, "rms": 0.0})
+
+    monkeypatch.setattr(FoldInEngine, "fold_in", boom)
+    ctx, ns = make_ctx(tag="streamfail")
+    with pytest.raises(FoldInDiverged):
+        run_stream(ctx, ns, _opts(cycles=2))
+    on_disk = json.loads(
+        store.artifact_path(ctx.artifact_name(JOURNAL_NAME)).read_text()
+    )
+    assert on_disk["status"] == "failed"
+    assert on_disk["cycles"][0]["status"] == "failed"
+    assert "FoldInDiverged" in on_disk["cycles"][0]["error"]
+    assert len(on_disk["cycles"]) == 1  # died in cycle 1, cycle 2 never ran
+
+
+def test_run_stream_job_is_registered():
+    import albedo_tpu.builders  # noqa: F401
+
+    from albedo_tpu.cli import _JOBS
+
+    assert "run_stream" in _JOBS
