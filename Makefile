@@ -11,7 +11,8 @@ JOBS = popularity curation content train_als cv_als build_user_profile \
        run_pipeline datacheck run_stream
 
 .PHONY: $(JOBS) test test-all bench serve-bench datacheck-bench chaos \
-        chaos-serve chaos-stream stream stream-bench dryrun
+        chaos-serve chaos-stream stream stream-bench dryrun soak soak-smoke \
+        capacity-bench
 
 $(JOBS):
 	$(PY) -m albedo_tpu.cli $@ $(ARGS)
@@ -64,6 +65,24 @@ stream:
 # trials, medians — per the bench-box throttling policy).
 stream-bench:
 	$(PY) bench.py foldin
+
+# Full-loop chaos soak: seeded random fault schedules over the whole
+# catalogued site inventory, driven through repeated ingest -> train ->
+# publish -> serve -> stream cycles with the standing invariants checked
+# every cycle (albedo_tpu/chaos/soak.py). Bounded: 10 cycles, seeded.
+# Exit 1 on the first broken invariant; report lands in the artifact dir.
+soak:
+	JAX_PLATFORMS=cpu $(PY) -m albedo_tpu.cli soak --small $(ARGS)
+
+# The fast in-process subset (kill/term excluded) — also runs in tier-1
+# under the chaos marker.
+soak-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_soak.py -q -m chaos
+
+# Capacity scenario: chunked-fallback overhead vs the device-resident fit
+# (interleaved trials, medians — per the bench-box throttling policy).
+capacity-bench:
+	$(PY) bench.py capacity
 
 dryrun:
 	$(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('ok')"

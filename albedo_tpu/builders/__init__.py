@@ -25,6 +25,7 @@ from albedo_tpu.builders.ranker import (
 from albedo_tpu.builders import jobs as _jobs  # noqa: F401  (registers CLI jobs)
 from albedo_tpu.builders import pipeline as _pipeline  # noqa: F401  (run_pipeline job)
 from albedo_tpu.streaming import job as _stream_job  # noqa: F401  (run_stream job)
+from albedo_tpu.chaos import soak as _soak_job  # noqa: F401  (chaos soak job)
 
 __all__ = [
     "ALSScorer",

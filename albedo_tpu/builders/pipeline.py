@@ -37,7 +37,7 @@ from albedo_tpu.cli import register_job
 from albedo_tpu.utils import faults
 from albedo_tpu.utils.checkpoint import Preempted
 from albedo_tpu.utils.jsonio import atomic_write_json, read_json_or_none
-from albedo_tpu.utils.retry import RetryPolicy, retry_call
+from albedo_tpu.utils.retry import RetryPolicy, default_retry_predicate, retry_call
 
 _STAGE_FAULT = faults.site("pipeline.stage")
 # The publish quality gate's own site: fires inside the canary evaluation so
@@ -345,8 +345,13 @@ def run_pipeline(
                 # A preemption notice is NOT a transient failure: retrying
                 # would restart training under a scheduler that is about to
                 # hard-kill us. A canary-gate refusal is a VERDICT — the
-                # same artifact would score the same again. Both propagate.
-                retry_on=lambda e: not isinstance(e, (Preempted, PublishRejected)),
+                # same artifact would score the same again. And a device OOM
+                # re-OOMs identically: burning the backoff budget re-crashing
+                # the device delays the capacity degrade path. All propagate.
+                retry_on=lambda e: (
+                    not isinstance(e, (Preempted, PublishRejected))
+                    and default_retry_predicate(e)
+                ),
             )
         except Preempted:
             record.update(status="preempted", finished_at=time.time())

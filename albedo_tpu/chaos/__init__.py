@@ -1,0 +1,7 @@
+"""Chaos engineering drivers: the full-loop soak (``albedo_tpu.chaos.soak``).
+
+The per-site drills live next to the code they drill (``tests/test_chaos_*``);
+this package holds the harnesses that drive the WHOLE system — every
+subsystem, every fault kind, repeated cycles — and check the standing
+invariants between cycles.
+"""

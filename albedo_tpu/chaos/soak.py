@@ -1,0 +1,671 @@
+"""Full-loop chaos soak: seeded fault schedules over the whole fault-site
+inventory, driven through repeated ingest -> train -> publish -> serve ->
+stream cycles, with the standing invariants checked every cycle.
+
+PRs 3-6 built fault tolerance one subsystem at a time, each with its own
+drills; this is the missing INTEGRATION test over all of it at once. One
+soak run:
+
+1. draws a deterministic fault schedule (``--soak-seed``) over the
+   catalogued site inventory — every kind (error/ioerror/corrupt/delay/
+   kill/term/oom) appears at least once, placed where its effect is
+   observable;
+2. runs ``--soak-cycles`` full loops, each: a **mesh boot** (degraded-remesh
+   ladder), the **offline pipeline** (ingest -> train_als -> canary publish,
+   a real CLI subprocess so kill/term faults genuinely kill something), a
+   **serve leg** (validated hot-swap of the published artifact through the
+   real reload gates + live probes), and a **stream leg** (validated delta
+   ingest -> fold-in -> stamped publish);
+3. checks the standing invariants after every cycle:
+
+   - **no unstamped artifact served** — a promoted generation's origin
+     passed the manifest + quality-stamp gates (``require_stamp``);
+   - **no half-applied delta / torn publish** — every artifact carrying a
+     ``.sha256`` manifest verifies against it, and every journal parses
+     (atomic writes);
+   - **exit codes honor the contract** — subprocess legs exit 0 (ok),
+     1 (stage failure), 3 (fold-in diverged), 4 (canary refusal),
+     75 (preempted) or 137 (killed by an injected ``kill``); anything else
+     is a harness bug;
+   - **factors finite** — the newest manifest-verified model artifact loads
+     to finite factor tables;
+   - **capacity rejections never quarantine** — a ``gate=capacity`` reload
+     rejection leaves the artifact bytes in place.
+
+A one-time **capacity drill** precedes the cycles: an over-budget fit must
+complete via the ``degrade`` verdict (chunked host-streamed path) and match
+the resident path's factors — the acceptance bar for the guardrail layer.
+
+The report (``<tag>-soak-report.json``, artifact dir) records every cycle's
+legs, exit codes, fired-fault evidence per kind, and invariant verdicts;
+the job exits 1 on the first broken invariant (after finishing the report).
+
+``make soak`` runs the subprocess flavor; ``tests/test_soak.py`` runs the
+fast in-process ``soak-smoke`` subset (kill/term excluded — they would kill
+the test runner) under the ``chaos`` marker.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import random
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+
+from albedo_tpu.cli import register_job
+from albedo_tpu.utils import events, faults
+
+log = logging.getLogger(__name__)
+
+REPORT_NAME = "soak-report.json"
+
+# Exit codes the offline contract allows a subprocess leg to report. 137 is
+# the injected-kill signature (os._exit(137), the preempted-pod code) — legal
+# only on a cycle that armed a kill.
+CONTRACT_CODES = {0, 1, 3, 4, 75}
+KILL_CODE = 137
+
+# --- the schedulable inventory -------------------------------------------------
+# (site, kind) pairs the seeded scheduler draws extra chaos from, keyed by the
+# leg that must arm them. Kill/term only ever land in subprocess legs (they
+# would kill the soak driver itself); in-process legs stick to raising kinds
+# whose firing the driver can read back from the fault registry.
+
+PIPELINE_FAULTS = (
+    ("pipeline.stage.ingest", "error"),
+    ("pipeline.stage.train_als", "error"),
+    ("pipeline.stage.canary", "delay"),
+    ("pipeline.canary", "error"),
+    ("data.validate", "error"),
+    ("train.watchdog", "error"),
+    ("artifact.load", "ioerror"),
+    ("artifact.load", "corrupt"),
+    ("artifact.save", "delay"),
+    ("capacity.admit", "oom"),
+)
+STREAM_FAULTS = (
+    ("stream.ingest", "error"),
+    ("stream.drift", "error"),
+    ("stream.foldin", "error"),
+    ("capacity.admit", "oom"),
+)
+SERVE_FAULTS = (
+    ("reload.load", "ioerror"),
+    ("reload.load", "corrupt"),
+    ("reload.load", "delay"),
+    ("reload.validate", "error"),
+    ("capacity.admit", "oom"),
+)
+MESH_FAULTS = (("mesh.devices", "error"),)
+
+# Canonical per-kind evidence placements: where each kind is armed so its
+# firing is OBSERVABLE regardless of what else the cycle draws. The mesh and
+# serve legs always run in-process (fired counters are readable); the serve
+# leg ends with an explicit admission probe, so `capacity.admit` is reachable
+# even when an earlier reload gate rejected the candidate first. kill/term
+# are subprocess-only (their evidence is the exit code): term at
+# checkpoint.save on the FIRST cycle (the only one guaranteed to train from
+# scratch, where the preemption handler is installed -> exit 75), kill at the
+# stage wrapper, which fires on every cycle -> exit 137.
+KIND_EVIDENCE = {
+    "error": ("mesh", "mesh.devices", "error"),
+    "delay": ("mesh", "mesh.devices", "delay"),
+    "ioerror": ("serve", "reload.load", "ioerror"),
+    "corrupt": ("serve", "reload.load", "corrupt"),
+    "oom": ("serve", "capacity.admit", "oom"),
+    "term": ("pipeline", "checkpoint.save", "term"),
+    "kill": ("pipeline", "pipeline.stage.train_als", "kill"),
+}
+
+
+def build_schedule(
+    cycles: int, seed: int, include_kill_term: bool
+) -> list[dict]:
+    """The deterministic soak schedule: per cycle, which (leg, site, kind)
+    faults arm. Random draws from the inventory add breadth; a coverage
+    pass then pins every kind's canonical evidence placement onto a
+    concrete cycle — displacing any random draw on the same site, because
+    only the FIRST matching armed spec fires at a given hit."""
+    if cycles < 2:
+        raise ValueError("the soak needs at least 2 cycles for kind coverage")
+    rng = random.Random(seed)
+    schedule: list[dict] = [
+        {"pipeline": [], "stream": [], "serve": [], "mesh": []}
+        for _ in range(cycles)
+    ]
+    pools = {
+        "pipeline": PIPELINE_FAULTS,
+        "stream": STREAM_FAULTS,
+        "serve": SERVE_FAULTS,
+        "mesh": MESH_FAULTS,
+    }
+    for c in range(cycles):
+        for leg, pool in pools.items():
+            if rng.random() < (0.6 if leg != "mesh" else 0.3):
+                site, kind = rng.choice(pool)
+                schedule[c][leg].append((site, kind, 1))
+    kinds = [
+        k for k in KIND_EVIDENCE
+        if include_kill_term or k not in ("kill", "term")
+    ]
+    for i, kind in enumerate(kinds):
+        leg, site, k = KIND_EVIDENCE[kind]
+        if kind == "term":
+            cycle, at = 0, 2  # checkpoint 2 of the from-scratch training fit
+        elif kind == "kill":
+            cycle, at = 1, 1
+        else:
+            cycle, at = i % cycles, 1
+        # Same-site displacement: two armed specs on one site race for the
+        # same hit; the canonical evidence spec must be the one that fires.
+        schedule[cycle][leg] = [
+            (s, kd, a) for s, kd, a in schedule[cycle][leg] if s != site
+        ] + [(site, k, at)]
+    # A kill/term pipeline leg must not ALSO carry raising faults that could
+    # fail the stage before the preemption fires.
+    for c in range(cycles):
+        legs = schedule[c]["pipeline"]
+        if any(k in ("kill", "term") for _, k, _ in legs):
+            schedule[c]["pipeline"] = [
+                (s, k, a) for s, k, a in legs if k in ("kill", "term")
+            ][:1]
+    return schedule
+
+
+def faults_env(specs: list[tuple[str, str, int]]) -> str:
+    return ",".join(f"{site}:{kind}@{at}" for site, kind, at in specs)
+
+
+# --- invariants -----------------------------------------------------------------
+
+
+def check_invariants(art_dir: Path) -> list[str]:
+    """Host-side sweep of the standing invariants; returns violations."""
+    from albedo_tpu.datasets import artifacts as store
+
+    violations: list[str] = []
+    if not art_dir.exists():
+        return violations
+    for p in sorted(art_dir.glob("*")):
+        name = p.name
+        if ".corrupt-" in name or ".quarantine-" in name or name.endswith(".tmp"):
+            continue
+        if name.endswith(store.MANIFEST_SUFFIX):
+            target = p.with_name(name[: -len(store.MANIFEST_SUFFIX)])
+            if target.exists() and store.verify_manifest(target) is False:
+                violations.append(f"torn publish: {target.name} fails its manifest")
+        if name.endswith("journal.json"):
+            try:
+                json.loads(p.read_text())
+            except ValueError:
+                violations.append(f"unparseable journal (non-atomic write?): {name}")
+    # The newest manifest-verified model artifact must load to finite factors.
+    candidates = [
+        p for p in sorted(
+            art_dir.glob("*alsModel*.pkl"), key=lambda q: q.stat().st_mtime
+        )
+        if ".corrupt-" not in p.name
+        and store.manifest_path(p).exists()
+        and store.verify_manifest(p) is not False
+    ]
+    if candidates:
+        newest = candidates[-1]
+        try:
+            import pickle
+
+            arrays = pickle.loads(newest.read_bytes())
+            for key in ("user_factors", "item_factors"):
+                if not np.isfinite(np.asarray(arrays[key])).all():
+                    violations.append(f"non-finite factors in {newest.name}")
+        except Exception as e:  # noqa: BLE001
+            violations.append(f"unloadable sealed artifact {newest.name}: {e!r}")
+    return violations
+
+
+# --- the one-time capacity drill ------------------------------------------------
+
+
+def capacity_drill() -> dict:
+    """An over-budget fit must complete via `degrade` (chunked path) and
+    match the resident path — the guardrail layer's acceptance bar, run
+    once per soak on a small synthetic matrix."""
+    from albedo_tpu.datasets.synthetic import synthetic_stars
+    from albedo_tpu.models.als import ImplicitALS
+
+    from albedo_tpu.utils import capacity
+
+    matrix = synthetic_stars(n_users=96, n_items=64, mean_stars=6, seed=5)
+    kw = dict(rank=8, max_iter=3, seed=0, batch_size=32)
+    resident = ImplicitALS(**kw, chunked=False).fit(matrix)
+    est = ImplicitALS(**kw)
+    plan = est.capacity_plan(matrix)
+    chunked_plan = est.capacity_plan(matrix, chunked=True)
+    # A budget squarely between the resident and chunked plans: the resident
+    # path must not fit, the chunked one must (headroom un-scaled back out).
+    target = (plan.required_bytes + chunked_plan.required_bytes) // 2
+    before = faults.FAULTS.hits("als.chunked")
+    prev = os.environ.get("ALBEDO_DEVICE_MEM_BYTES")
+    os.environ["ALBEDO_DEVICE_MEM_BYTES"] = str(
+        max(1, int(target / capacity.headroom()))
+    )
+    try:
+        matrix2 = synthetic_stars(n_users=96, n_items=64, mean_stars=6, seed=5)
+        degraded = est.fit(matrix2)
+    finally:
+        if prev is None:
+            os.environ.pop("ALBEDO_DEVICE_MEM_BYTES", None)
+        else:
+            os.environ["ALBEDO_DEVICE_MEM_BYTES"] = prev
+    mode = est.last_fit_report.get("mode")
+    max_delta = float(
+        max(
+            np.abs(resident.user_factors - degraded.user_factors).max(),
+            np.abs(resident.item_factors - degraded.item_factors).max(),
+        )
+    )
+    ok = mode == "chunked" and max_delta < 1e-4 and (
+        faults.FAULTS.hits("als.chunked") > before
+    )
+    return {
+        "ok": bool(ok),
+        "mode": mode,
+        "max_factor_delta": max_delta,
+        "verdict": (est.last_fit_report.get("capacity") or {}).get("verdict"),
+    }
+
+
+# --- legs -----------------------------------------------------------------------
+
+
+def _cli_env(specs, extra_env=None) -> dict:
+    env = dict(os.environ)
+    env.pop("ALBEDO_FAULTS", None)
+    if specs:
+        env["ALBEDO_FAULTS"] = faults_env(specs)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env.update(extra_env or {})
+    return env
+
+
+def _run_cli(job: str, cli_args: list[str], specs, timeout: float,
+             extra_env=None) -> dict:
+    cmd = [sys.executable, "-m", "albedo_tpu.cli", job, *cli_args]
+    t0 = time.time()
+    try:
+        proc = subprocess.run(
+            cmd, capture_output=True, text=True,
+            env=_cli_env(specs, extra_env), timeout=timeout,
+        )
+        rc: int | str = proc.returncode
+        tail = (proc.stdout + proc.stderr)[-2000:]
+    except subprocess.TimeoutExpired:
+        rc, tail = "timeout", ""
+    return {
+        "job": job, "rc": rc, "faults": [f"{s}:{k}@{a}" for s, k, a in specs],
+        "wall_s": round(time.time() - t0, 1), "tail": tail,
+    }
+
+
+class _InProcessArm:
+    """Arm faults through the registry for an in-process leg, recording the
+    per-site fired deltas on exit (the smoke mode's evidence channel)."""
+
+    def __init__(self, specs):
+        self.specs = specs
+        self.fired: dict[str, int] = {}
+
+    def __enter__(self):
+        self._before = {s: faults.FAULTS.fired(s) for s, _, _ in self.specs}
+        for site, kind, at in self.specs:
+            faults.arm(site, kind=kind, at=at)
+        return self
+
+    def __exit__(self, *exc):
+        for site, _, _ in self.specs:
+            faults.disarm(site)
+            self.fired[site] = faults.FAULTS.fired(site) - self._before[site]
+        return False
+
+
+def _pipeline_in_process(ctx_factory, specs, resume: bool) -> dict:
+    from albedo_tpu.builders.pipeline import (
+        PipelineStageFailed, PublishRejected, run_pipeline,
+    )
+    from albedo_tpu.utils.checkpoint import Preempted
+
+    rc, err = 0, None
+    with _InProcessArm(specs) as armed:
+        try:
+            run_pipeline(
+                ctx_factory(), resume=resume,
+                stages=["ingest", "train_als", "canary"],
+                sleeper=lambda s: None, verbose=False,
+            )
+        except PublishRejected as e:
+            rc, err = 4, repr(e)
+        except Preempted as e:
+            rc, err = 75, repr(e)
+        except PipelineStageFailed as e:
+            rc, err = 1, repr(e)
+        except Exception as e:  # noqa: BLE001 — the CLI would exit 1 too
+            rc, err = 1, repr(e)
+    return {"job": "run_pipeline", "rc": rc, "fired": armed.fired,
+            "error": err, "faults": [f"{s}:{k}@{a}" for s, k, a in specs]}
+
+
+def _stream_in_process(ctx_factory, args, specs, cycle_seed: int) -> dict:
+    from albedo_tpu.builders.pipeline import PipelineStageFailed, PublishRejected
+    from albedo_tpu.streaming.foldin import FoldInDiverged
+    from albedo_tpu.streaming.job import run_stream
+
+    opts = argparse.Namespace(
+        cycles=1, delta_batch=60, stream_seed=cycle_seed, deltas="",
+        drift_tolerance=0.05, drift_floor=0.0, drift_every=1,
+        half_life_days=7.0, recency_boost=1.0, foldout_limit=0,
+        max_foldin_batch=16, probe_users=40, no_publish=False,
+        keep_stream=3, refit_checkpoint_every=2,
+    )
+    rc, err = 0, None
+    with _InProcessArm(specs) as armed:
+        try:
+            run_stream(ctx_factory(), args, opts)
+        except FoldInDiverged as e:
+            rc, err = 3, repr(e)
+        except PublishRejected as e:
+            rc, err = 4, repr(e)
+        except PipelineStageFailed as e:
+            rc, err = 1, repr(e)
+        except Exception as e:  # noqa: BLE001 — the CLI would exit 1 too
+            rc, err = 1, repr(e)
+    return {"job": "run_stream", "rc": rc, "fired": armed.fired,
+            "error": err, "faults": [f"{s}:{k}@{a}" for s, k, a in specs]}
+
+
+def _mesh_leg(specs) -> dict:
+    """The boot leg: a mesh request that may exceed the visible devices (or
+    lose half of them to a mesh.devices fault) must remesh down the ladder,
+    never assert-crash."""
+    import jax
+
+    from albedo_tpu.parallel.mesh import make_mesh
+
+    before = events.mesh_degraded.total()
+    with _InProcessArm(specs) as armed:
+        mesh = make_mesh(8)  # more than a 1-device CPU soak box has
+    n = int(np.prod(list(mesh.shape.values())))
+    return {
+        "job": "mesh_boot", "rc": 0 if n >= 1 else 1,
+        "devices": n, "visible": len(jax.devices()),
+        "degraded": events.mesh_degraded.total() - before,
+        "fired": armed.fired,
+        "faults": [f"{s}:{k}@{a}" for s, k, a in specs],
+    }
+
+
+def _serve_leg(ctx, specs) -> dict:
+    """In-process serving leg: boot a service on the current model, drive
+    one validated reload of the newest published candidate through the REAL
+    gates (require_stamp on), then probe live traffic. The incumbent must
+    keep answering whatever the gates decide."""
+    from albedo_tpu.serving import HotSwapManager, RecommendationService
+
+    out: dict = {"job": "serve", "rc": 0, "fired": {}, "probes": 0,
+                 "faults": [f"{s}:{k}@{a}" for s, k, a in specs]}
+    service = RecommendationService(
+        ctx.als_model(), ctx.matrix(),
+        repo_info=ctx.tables().repo_info, user_info=ctx.tables().user_info,
+        batching=True, batch_window_ms=0.0, warm=False,
+    )
+    try:
+        manager = HotSwapManager(
+            service, artifact_glob=f"{ctx.tag}-alsModel-*.pkl",
+            require_stamp=True,
+        )
+        with _InProcessArm(specs) as armed:
+            report = manager.request_reload()
+        out["fired"] = armed.fired
+        out["reload_outcome"] = report.get("outcome")
+        out["reload_gate"] = report.get("gate")
+        # Invariant: a capacity rejection is recorded, never quarantined.
+        if report.get("gate") == "capacity":
+            art = report.get("artifact")
+            if art and not (
+                Path(ctx_artifact_dir() / art).exists()
+            ):
+                out["rc"] = 1
+                out["error"] = "capacity rejection quarantined the artifact"
+        # Invariant: whatever happened above, live traffic still answers.
+        matrix = ctx.matrix()
+        users = matrix.user_ids[np.linspace(
+            0, matrix.n_users - 1, 3, dtype=np.int64
+        )]
+        for uid in users:
+            status, body = service.handle_recommend(int(uid), k=5)
+            if status == 200 and all(
+                np.isfinite(i["score"]) for i in body.get("items", [])
+            ):
+                out["probes"] += 1
+            else:
+                out["rc"] = 1
+                out["error"] = f"probe user {uid}: status {status}"
+        # Invariant: no unstamped artifact served — require_stamp guarantees
+        # a promoted candidate passed the stamp gate; assert the record.
+        if out["reload_outcome"] == "promoted":
+            stamp = report["gates"].get("stamp")
+            if not isinstance(stamp, dict):
+                out["rc"] = 1
+                out["error"] = "promoted without a stamp-gate record"
+        # Admission probe: one explicit degradable admission, so the
+        # capacity.admit site is reachable this leg even when an earlier
+        # reload gate rejected the candidate before its capacity gate. An
+        # armed oom must convert to a `degrade` verdict, never a crash.
+        from albedo_tpu.utils import capacity
+
+        with _InProcessArm(
+            [s for s in specs if s[0] == "capacity.admit"]
+        ) as probe_armed:
+            verdict = capacity.admit(
+                capacity.plan_foldin(8, 8, 8, 64), degradable=True
+            )
+        out["admission_probe"] = verdict.verdict
+        for site, n in probe_armed.fired.items():
+            out["fired"][site] = out["fired"].get(site, 0) + n
+        if verdict.verdict == "refuse":
+            out["rc"] = 1
+            out["error"] = "degradable admission probe refused"
+    finally:
+        service.close()
+    return out
+
+
+def ctx_artifact_dir() -> Path:
+    from albedo_tpu.datasets import artifacts as store
+
+    return store.get_settings().artifact_dir
+
+
+# --- the driver -----------------------------------------------------------------
+
+
+def run_soak(
+    args,
+    cycles: int = 10,
+    seed: int = 42,
+    subprocess_legs: bool = True,
+    leg_timeout: float = 560.0,
+    ctx_kwargs: dict | None = None,
+) -> dict:
+    """Drive the soak; returns the report dict (also written to the store).
+
+    ``subprocess_legs=False`` is the smoke flavor: pipeline/stream run
+    in-process (kill/term excluded — they would kill the caller), every
+    fired fault is read back from the in-process registry. ``ctx_kwargs``
+    (e.g. ``tables=``/``tag=``) shrink the in-process dataset for smoke runs.
+    """
+    from albedo_tpu.builders.jobs import JobContext
+
+    # Pin ONE date for the whole run (today's, unless the caller pinned
+    # their own): the in-process legs and every subprocess leg must key the
+    # same artifact tag even across a midnight boundary.
+    os.environ.setdefault("ALBEDO_TODAY", time.strftime("%Y%m%d"))
+    t0 = time.time()
+    schedule = build_schedule(cycles, seed, include_kill_term=subprocess_legs)
+
+    def ctx_factory():
+        return JobContext(args, **(ctx_kwargs or {}))
+
+    report: dict = {
+        "seed": seed,
+        "cycles_planned": cycles,
+        "subprocess_legs": subprocess_legs,
+        "capacity_drill": capacity_drill(),
+        "cycles": [],
+        "kinds_observed": {},
+        "violations": [],
+    }
+    kinds_observed: dict[str, str] = {}
+    resume_next = False
+
+    def observe_in_process(leg_record, specs):
+        for site, kind, _ in specs:
+            if leg_record.get("fired", {}).get(site, 0) > 0:
+                kinds_observed.setdefault(
+                    kind, f"fired in-process at {site} "
+                    f"(cycle {len(report['cycles']) + 1})"
+                )
+
+    for c, plan in enumerate(schedule):
+        cycle: dict = {"cycle": c + 1, "legs": []}
+
+        mesh_rec = _mesh_leg(plan["mesh"])
+        cycle["legs"].append(mesh_rec)
+        observe_in_process(mesh_rec, plan["mesh"])
+
+        pipeline_args = [
+            "--small", "--checkpoint-every", "2",
+            "--stages", "ingest,train_als,canary",
+        ]
+        if subprocess_legs:
+            rec = _run_cli(
+                "run_pipeline",
+                pipeline_args + (["--resume"] if resume_next else []),
+                plan["pipeline"], leg_timeout,
+            )
+        else:
+            rec = _pipeline_in_process(ctx_factory, plan["pipeline"], resume_next)
+            observe_in_process(rec, plan["pipeline"])
+        cycle["legs"].append(rec)
+        armed_kinds = {k for _, k, _ in plan["pipeline"]}
+        if rec["rc"] == KILL_CODE and "kill" in armed_kinds:
+            kinds_observed.setdefault("kill", f"exit 137 (cycle {c + 1})")
+        if rec["rc"] == 75 and "term" in armed_kinds:
+            kinds_observed.setdefault("term", f"exit 75 (cycle {c + 1})")
+        allowed = CONTRACT_CODES | ({KILL_CODE} if "kill" in armed_kinds else set())
+        if rec["rc"] not in allowed:
+            report["violations"].append(
+                f"cycle {c + 1} pipeline exit code {rec['rc']} outside the "
+                f"contract {sorted(allowed)}"
+            )
+        resume_next = rec["rc"] in (75, KILL_CODE)
+
+        serve_rec = _serve_leg(ctx_factory(), plan["serve"])
+        cycle["legs"].append(serve_rec)
+        observe_in_process(serve_rec, plan["serve"])
+        if serve_rec["rc"] != 0:
+            report["violations"].append(
+                f"cycle {c + 1} serve leg: {serve_rec.get('error', 'failed')}"
+            )
+
+        if subprocess_legs:
+            stream_rec = _run_cli(
+                "run_stream",
+                ["--small", "--cycles", "1", "--delta-batch", "60",
+                 "--stream-seed", str(seed + c), "--probe-users", "40"],
+                plan["stream"], leg_timeout,
+            )
+        else:
+            stream_rec = _stream_in_process(
+                ctx_factory, args, plan["stream"], seed + c
+            )
+            observe_in_process(stream_rec, plan["stream"])
+        cycle["legs"].append(stream_rec)
+        s_kinds = {k for _, k, _ in plan["stream"]}
+        s_allowed = CONTRACT_CODES | ({KILL_CODE} if "kill" in s_kinds else set())
+        if stream_rec["rc"] not in s_allowed:
+            report["violations"].append(
+                f"cycle {c + 1} stream exit code {stream_rec['rc']} outside "
+                f"the contract {sorted(s_allowed)}"
+            )
+
+        cycle["invariant_violations"] = check_invariants(ctx_artifact_dir())
+        report["violations"].extend(
+            f"cycle {c + 1}: {v}" for v in cycle["invariant_violations"]
+        )
+        report["cycles"].append(cycle)
+        log.info(
+            "soak cycle %d/%d: rcs=%s violations=%d", c + 1, cycles,
+            [leg["rc"] for leg in cycle["legs"]],
+            len(cycle["invariant_violations"]),
+        )
+
+    if not report["capacity_drill"]["ok"]:
+        report["violations"].append(
+            f"capacity drill failed: {report['capacity_drill']}"
+        )
+    expected_kinds = set(KIND_EVIDENCE)
+    if not subprocess_legs:
+        expected_kinds -= {"kill", "term"}
+    missing = expected_kinds - set(kinds_observed)
+    if missing:
+        report["violations"].append(
+            f"fault kinds never observed firing: {sorted(missing)}"
+        )
+    report["kinds_observed"] = kinds_observed
+    report["wall_clock_s"] = round(time.time() - t0, 1)
+    report["ok"] = not report["violations"]
+
+    from albedo_tpu.utils.jsonio import atomic_write_json
+
+    out_path = ctx_artifact_dir() / REPORT_NAME
+    out_path.parent.mkdir(parents=True, exist_ok=True)
+    atomic_write_json(out_path, report, indent=2)
+    report["report_path"] = str(out_path)
+    return report
+
+
+@register_job("soak")
+def soak_job(args) -> int | None:
+    """The full-loop chaos soak (see module docstring).
+
+    Extra flags: --soak-cycles N (default 10), --soak-seed N (default 42),
+    --in-process (the smoke flavor: pipeline/stream legs run in-process and
+    kill/term kinds are excluded), --leg-timeout SECONDS (default 560).
+    Honors the global --small (recommended) and --tables. Exit codes:
+    0 every invariant green, 1 otherwise.
+    """
+    extra = argparse.ArgumentParser()
+    extra.add_argument("--soak-cycles", type=int, default=10)
+    extra.add_argument("--soak-seed", type=int, default=42)
+    extra.add_argument("--in-process", action="store_true")
+    extra.add_argument("--leg-timeout", type=float, default=560.0)
+    ns, _ = extra.parse_known_args(getattr(args, "_rest", []))
+
+    report = run_soak(
+        args, cycles=ns.soak_cycles, seed=ns.soak_seed,
+        subprocess_legs=not ns.in_process, leg_timeout=ns.leg_timeout,
+    )
+    print(f"[soak] {report['cycles_planned']} cycle(s) in "
+          f"{report['wall_clock_s']}s; kinds observed: "
+          f"{sorted(report['kinds_observed'])}")
+    for v in report["violations"]:
+        print(f"[soak] INVARIANT VIOLATED: {v}")
+    print(f"[soak] report: {report['report_path']}")
+    print(f"[soak] {'ALL INVARIANTS GREEN' if report['ok'] else 'FAILED'}")
+    return None if report["ok"] else 1

@@ -35,7 +35,14 @@ from albedo_tpu.datasets.ragged import (
 from albedo_tpu.datasets.star_matrix import StarMatrix
 from albedo_tpu.ops.als import als_fit_fused, als_init_fit_fused
 from albedo_tpu.ops.topk import topk_scores
+from albedo_tpu.utils import capacity as capacity_mod
+from albedo_tpu.utils import faults
 from albedo_tpu.utils.aot import persistent_aot_call, persistent_aot_executable
+
+# Chaos hook for the chunked host-streamed fallback: fires ahead of every
+# chunked half-sweep, so drills can kill/fail a degraded fit mid-stream
+# exactly like they kill the resident path mid-checkpoint.
+_CHUNKED_FAULT = faults.site("als.chunked")
 
 
 class ALSModel:
@@ -239,6 +246,10 @@ class ImplicitALS:
     # Optional (user_factors, item_factors) warm start — resume-from-checkpoint
     # (utils.checkpoint.checkpointed_als_fit) instead of the seeded init.
     init_factors: tuple | None = None
+    # Memory-budget admission (utils.capacity): None = the admission verdict
+    # decides (a `degrade` falls back to the chunked host-streamed path),
+    # True/False force the chunked/resident path (bench A/B, tests).
+    chunked: bool | None = None
 
     def _layout_kwargs(self) -> dict:
         return dict(
@@ -431,11 +442,65 @@ class ImplicitALS:
             matrix.n_users, matrix.n_items, groups_sig,
         )
 
+    # ---------------------------------------------------- capacity admission
+
+    def _plan_shapes(self, matrix: StarMatrix) -> tuple[list, list]:
+        """(user, item) bucket shapes from the PLANNER alone: indptrs come
+        from a bincount over the raw row/col ids — no slab filled, no byte
+        uploaded, and none of the O(nnz log nnz) argsorts a full csr()/csc()
+        view would redundantly pay before the real bucketing pays them."""
+        kw = self._layout_kwargs()
+        return (
+            capacity_mod.bucket_plan_shapes(
+                capacity_mod.counts_indptr(matrix.rows, matrix.n_users), **kw
+            ),
+            capacity_mod.bucket_plan_shapes(
+                capacity_mod.counts_indptr(matrix.cols, matrix.n_items), **kw
+            ),
+        )
+
+    def capacity_plan(self, matrix: StarMatrix, chunked: bool = False):
+        """Static byte pricing of this fit's layout (``utils.capacity``)."""
+        shapes_u, shapes_i = self._plan_shapes(matrix)
+        fn = capacity_mod.plan_fit_chunked if chunked else capacity_mod.plan_fit
+        return fn(
+            shapes_u, shapes_i, matrix.n_users, matrix.n_items,
+            self.rank, self.gather_dtype,
+        )
+
+    def admission(self, matrix: StarMatrix):
+        """Admission verdict for fitting ``matrix`` on this estimator's
+        layout: ``fit`` = resident path, ``degrade`` = chunked host-streamed
+        fallback. When even the chunked plan (factor tables + one bucket in
+        flight) busts the budget, raises :class:`~albedo_tpu.utils.capacity.
+        CapacityExceeded` — that matrix needs the sharded mesh path, not a
+        single device. One admission, one counted verdict: the chunked plan
+        rides along as ``fallback_plan`` instead of a second admit()."""
+        shapes_u, shapes_i = self._plan_shapes(matrix)
+        args = (shapes_u, shapes_i, matrix.n_users, matrix.n_items,
+                self.rank, self.gather_dtype)
+        verdict = capacity_mod.admit(
+            capacity_mod.plan_fit(*args), degradable=True,
+            fallback_plan=capacity_mod.plan_fit_chunked(*args),
+        )
+        if verdict.verdict == "refuse":
+            raise capacity_mod.CapacityExceeded(verdict)
+        return verdict
+
+    # -------------------------------------------------------------- training
+
     def fit(self, matrix: StarMatrix, callback: Any | None = None) -> ALSModel:
         """Train factors on the default backend, or sharded over ``self.mesh``.
 
         ``callback(iteration, user_factors, item_factors)`` if given is invoked
         after each full sweep (host arrays; for monitoring/tests).
+
+        Memory-budget admission runs first (single-device paths, cold layout
+        cache): a ``degrade`` verdict reroutes to the chunked host-streamed
+        fallback (:meth:`_fit_chunked`) instead of dispatching a resident
+        upload that would ``RESOURCE_EXHAUSTED``. ``self.chunked`` forces
+        either path; a warm groups cache implies the resident slabs already
+        fit (they are on device now).
 
         The returned model's factors are device arrays, fully computed on
         return (``block_until_ready``) — host copies materialize lazily via
@@ -449,6 +514,15 @@ class ImplicitALS:
         """
         t0 = time.perf_counter()
         cache_warm = self._groups_cache_key() in _matrix_cache(matrix)
+        admission = None
+        use_chunked = self.chunked
+        if use_chunked is None:
+            use_chunked = False
+            if self.mesh is None and not cache_warm and capacity_mod.enabled():
+                admission = self.admission(matrix)
+                use_chunked = admission.verdict == "degrade"
+        if use_chunked:
+            return self._fit_chunked(matrix, callback, admission, t0)
         ug, ig, u_land, i_land = self.device_groups(matrix)
         prep_split = dict(getattr(self, "last_prep_timings", {}))
         t1 = time.perf_counter()
@@ -457,17 +531,20 @@ class ImplicitALS:
         alpha = jnp.float32(self.alpha)
         compile_s = 0.0
         compile_source = None
+        compiled_handle = None  # for the capacity cross-check, when held
         if self.init_factors is None and callback is None:
             # Seeded init fused into the training program: the whole fit is
             # ONE dispatch (ops.als.als_init_fit_fused), AOT-compiled through
             # the persistent executable cache (utils.aot) so a fresh process
             # with the same bucket layout skips the trace+compile entirely.
-            (user_f, item_f), compile_s, compile_source = persistent_aot_call(
+            fused_args = (jax.random.PRNGKey(self.seed), ug, ig, reg, alpha,
+                          jnp.int32(self.max_iter))
+            fused_kwargs = dict(user_landing=u_land, item_landing=i_land)
+            compiled_handle, compile_s, compile_source = persistent_aot_executable(
                 als_init_fit_fused,
-                args=(jax.random.PRNGKey(self.seed), ug, ig, reg, alpha,
-                      jnp.int32(self.max_iter)),
-                dyn_kwargs=dict(user_landing=u_land, item_landing=i_land),
-                static_kwargs=dict(
+                fused_args,
+                fused_kwargs,
+                dict(
                     n_users=matrix.n_users, n_items=matrix.n_items,
                     rank=self.rank, solver=self.solver, cg_steps=self.cg_steps,
                     gather_dtype=self.gather_dtype,
@@ -475,6 +552,7 @@ class ImplicitALS:
                 key_parts=self._aot_key_parts("als_init_fit_fused", matrix, ug, ig),
                 name="als_init_fit_fused",
             )
+            user_f, item_f = compiled_handle(*fused_args, **fused_kwargs)
         else:
             if self.init_factors is not None:
                 user_f = jnp.asarray(self.init_factors[0], jnp.float32)
@@ -542,6 +620,15 @@ class ImplicitALS:
 
         health = health_dict(factor_health(user_f, item_f))
         t2 = time.perf_counter()
+        # Cross-check the static cost model against the compiler's own
+        # memory analysis when the executable handle is held — advisory
+        # (logged loudly on a >2x underestimate), so a stale model surfaces
+        # before it mis-admits a real workload.
+        cross = (
+            capacity_mod.cross_check(admission.plan, compiled_handle)
+            if admission is not None and compiled_handle is not None
+            else None
+        )
         self.last_fit_report = {
             "prep_s": round(t1 - t0, 4),
             "bucket_s": prep_split.get("bucket_s", 0.0),
@@ -551,6 +638,121 @@ class ImplicitALS:
             "device_s": round(t2 - t1 - compile_s, 4),
             "prep_cached": bool(cache_warm),
             "health": health,
+            "mode": "resident",
+            "capacity": None if admission is None else admission.to_dict(),
+            "capacity_cross_check": cross,
         }
 
+        return ALSModel(user_factors=user_f, item_factors=item_f, rank=self.rank)
+
+    def _fit_chunked(
+        self,
+        matrix: StarMatrix,
+        callback: Any | None,
+        admission,
+        t0: float,
+    ) -> ALSModel:
+        """The degraded-capacity fit: host-streamed bucket groups.
+
+        Only the factor tables stay device-resident; every half-sweep
+        re-uploads each bucket's slab and solves it with the SAME kernels as
+        the fused path (``ops.als.chunked_bucket_update`` wraps
+        ``bucket_solve_body``/``bucket_cg_body``), so the result is
+        numerics-parity with the resident path (pinned by
+        ``tests/test_als_chunked.py``) at a host-bandwidth-bound pace —
+        slower, never dead. Per-shape executables are acquired through the
+        persistent AOT layer, NOT bare jit: chunked fits run in exactly the
+        kill-resume chaos that exposed the PR 4 XLA-cache custom-call
+        corruption, so their cross-process executable reuse must stay
+        fingerprint-verified too.
+        """
+        from albedo_tpu.ops.als import chunked_bucket_update, gramian
+
+        if self.solver not in ("cholesky", "cg"):
+            raise ValueError(f"unknown solver {self.solver!r}")
+        user_buckets, item_buckets = self._host_buckets(matrix)
+        t1 = time.perf_counter()
+
+        if self.init_factors is not None:
+            user_f = jnp.asarray(self.init_factors[0], jnp.float32)
+            item_f = jnp.asarray(self.init_factors[1], jnp.float32)
+        else:
+            # Eager seeded init: same traced PRNG ops + key as the fused
+            # init, so the values are identical (see als_init_fit_fused).
+            key = jax.random.PRNGKey(self.seed)
+            ukey, ikey = jax.random.split(key)
+            scale = 1.0 / np.sqrt(self.rank)
+            user_f = jax.random.normal(ukey, (matrix.n_users, self.rank), jnp.float32) * scale
+            item_f = jax.random.normal(ikey, (matrix.n_items, self.rank), jnp.float32) * scale
+
+        reg = jnp.float32(self.reg_param)
+        alpha = jnp.float32(self.alpha)
+        statics = dict(
+            solver=self.solver, cg_steps=self.cg_steps,
+            gather_dtype=self.gather_dtype,
+        )
+        executables: dict[tuple, Any] = {}
+        compile_s = 0.0
+        compile_sources: set[str] = set()
+
+        def run_bucket(source, yty, target, b: Bucket):
+            nonlocal compile_s
+            args = (
+                source, yty, target,
+                jnp.asarray(b.row_ids), jnp.asarray(b.idx),
+                jnp.asarray(b.val), jnp.asarray(b.mask), reg, alpha,
+            )
+            key2 = (source.shape[0], target.shape[0], b.shape)
+            compiled = executables.get(key2)
+            if compiled is None:
+                dev = jax.devices()[0]
+                compiled, c_s, source_tag = persistent_aot_executable(
+                    chunked_bucket_update, args, None, statics,
+                    key_parts=(
+                        "als_chunked", jax.__version__, jax.default_backend(),
+                        getattr(dev, "device_kind", "?"),
+                        self.solver, self.cg_steps, self.gather_dtype,
+                        self.rank, source.shape[0], target.shape[0], b.shape,
+                    ),
+                    name="als_chunked",
+                )
+                executables[key2] = compiled
+                compile_s += c_s
+                compile_sources.add(source_tag)
+            return compiled(*args)
+
+        def half_sweep(source, target, buckets):
+            # The chaos hook: an armed kill dies genuinely mid-stream; an
+            # armed error/oom surfaces as a failed fit for the pipeline's
+            # fail-fast (not retried: is_resource_exhausted) handling.
+            _CHUNKED_FAULT.hit()
+            yty = gramian(source)
+            for b in buckets:
+                target = run_bucket(source, yty, target, b)
+            return target
+
+        for it in range(self.max_iter):
+            # MLlib order: item factors first (from users), then users.
+            item_f = half_sweep(user_f, item_f, item_buckets)
+            user_f = half_sweep(item_f, user_f, user_buckets)
+            if callback is not None:
+                callback(it, np.asarray(user_f), np.asarray(item_f))
+
+        from albedo_tpu.utils.watchdog import factor_health, health_dict
+
+        health = health_dict(factor_health(user_f, item_f))
+        t2 = time.perf_counter()
+        self.last_fit_report = {
+            "prep_s": round(t1 - t0, 4),
+            "bucket_s": round(t1 - t0, 4),
+            "upload_s": 0.0,  # uploads are streamed per bucket, inside device_s
+            "compile_s": round(compile_s, 4),
+            "compile_source": "+".join(sorted(compile_sources)) or None,
+            "device_s": round(t2 - t1 - compile_s, 4),
+            "prep_cached": False,
+            "health": health,
+            "mode": "chunked",
+            "capacity": None if admission is None else admission.to_dict(),
+            "chunked_shapes": len(executables),
+        }
         return ALSModel(user_factors=user_f, item_factors=item_f, rank=self.rank)

@@ -22,14 +22,25 @@ sharding code above this module is host-count-agnostic.
 
 from __future__ import annotations
 
+import logging
 import os
 
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from albedo_tpu.utils import events, faults
+
+log = logging.getLogger(__name__)
+
 DATA_AXIS = "data"
 ITEM_AXIS = "item"
+
+# Chaos hook: fires at every mesh construction. A fired fault (any raising
+# kind — error/oom) simulates half the slice dropping out: `make_mesh` sees
+# fewer devices than exist and must remesh down the ladder instead of
+# crashing (the degraded-mesh drill arms this).
+MESH_FAULT = faults.site("mesh.devices")
 
 
 _PROCESS_ID_HINT_ENVS = (
@@ -105,22 +116,81 @@ def init_distributed(
     return num_processes
 
 
+def degraded_ladder(requested: int, available: int, item: int = 1) -> int:
+    """The largest usable device count when fewer devices are visible than
+    requested: halve down the 8 -> 4 -> 2 -> 1 ladder until the rung fits
+    ``available`` and (when possible) stays divisible by ``item``. Never
+    returns less than 1 — a single device is always a valid (degraded)
+    mesh."""
+    n = max(1, int(requested))
+    while n > available and n > 1:
+        n //= 2
+    if item > 1:
+        m = n
+        while m > 1 and m % item:
+            m //= 2
+        if m % item == 0:
+            n = m
+    return max(1, n)
+
+
 def make_mesh(
     n_devices: int | None = None,
     data: int | None = None,
     item: int = 1,
     devices: list | None = None,
+    allow_degraded: bool = True,
 ) -> Mesh:
     """Build a ``(data, item)`` mesh over the first ``n_devices`` devices.
 
     By default all devices go on the ``data`` axis — the right layout while
     factor tables fit replicated (rank-50 factors for albedo-scale data are
     ~hundreds of MB). Give ``item > 1`` to shard the item axis as well.
+
+    **Degraded operation** (``allow_degraded``, default on): when fewer
+    devices are visible than requested — a partial slice at startup, or the
+    ``mesh.devices`` fault site simulating half the slice dropping out —
+    the mesh remeshes to the largest valid ladder rung (8 -> 4 -> 2 -> 1,
+    item axis collapsing to 1 if it no longer divides) instead of raising.
+    Loud by design: a warning names both counts, and the boot is counted in
+    ``albedo_mesh_degraded_total`` so dashboards can page on a fleet booting
+    smaller than its slice. An *explicitly inconsistent* shape request
+    (``data * item != n_devices`` with every device present) is still a
+    configuration error, not a degradation.
     """
-    devs = devices if devices is not None else jax.devices()
-    if n_devices is not None:
-        devs = devs[:n_devices]
-    n = len(devs)
+    all_devs = devices if devices is not None else jax.devices()
+    visible = len(all_devs)
+    try:
+        MESH_FAULT.hit()
+    except Exception as e:  # noqa: BLE001 — any raising kind = device loss
+        visible = max(1, visible // 2)
+        log.warning("mesh.devices fault fired (%r): %d of %d devices visible",
+                    e, visible, len(all_devs))
+    requested = int(n_devices) if n_devices is not None else (
+        data * item if data is not None else visible
+    )
+    n = requested
+    degraded_item = item
+    if requested > visible:
+        if not allow_degraded:
+            raise ValueError(
+                f"need {requested} devices, have {visible} "
+                "(degraded remesh disabled)"
+            )
+        n = degraded_ladder(requested, visible, item=item)
+        if item > 1 and n % item:
+            degraded_item = 1
+        log.warning(
+            "DEGRADED MESH: %d device(s) requested, %d visible — remeshed to "
+            "%d (item axis %d -> %d). Throughput is proportionally reduced; "
+            "results are unchanged.",
+            requested, visible, n, item, degraded_item,
+        )
+        events.mesh_degraded.inc()
+        # The requested shape no longer applies; re-derive it below.
+        data = None
+    devs = all_devs[:n]
+    item = degraded_item
     if data is None:
         if n % item != 0:
             raise ValueError(f"{n} devices not divisible by item={item}")

@@ -21,7 +21,13 @@ One reload attempt (``request_reload`` — also what the artifact watcher,
         │  gate 3: load       (unpickle + from_arrays; `reload.load` fault site)
         │  gate 4: invariants (finite factors, rank/shape match the matrix;
         │                      `reload.validate` fault site)
-        │  gate 5: probe      (fixed-probe top-k smoke test, compared against
+        │  gate 5: capacity   (memory-budget admission, utils.capacity: the
+        │                      candidate generation must fit ALONGSIDE the
+        │                      incumbent — two generations are resident for
+        │                      the whole swap. Refusal is a recorded
+        │                      rejection, NOT a quarantine: the artifact is
+        │                      fine, this process is full)
+        │  gate 6: probe      (fixed-probe top-k smoke test, compared against
         │                      the incumbent: finite scores, valid indices;
         │                      overlap/score-delta recorded)
         ▼
@@ -76,12 +82,19 @@ _SKIP_MARKERS = (".corrupt-", ".quarantine-", ".tmp")
 
 
 class ReloadRejected(Exception):
-    """A validation gate failed; ``gate`` names it, ``detail`` says why."""
+    """A validation gate failed; ``gate`` names it, ``detail`` says why.
 
-    def __init__(self, gate: str, detail: str):
+    ``quarantine=False`` marks a rejection that is a statement about THIS
+    process's capacity, not about the artifact's bytes (the capacity gate):
+    the candidate is recorded and skipped, never renamed to ``.corrupt-<n>``
+    — a bigger host, or the incumbent retiring, may admit it verbatim.
+    """
+
+    def __init__(self, gate: str, detail: str, quarantine: bool = True):
         super().__init__(f"{gate}: {detail}")
         self.gate = gate
         self.detail = detail
+        self.quarantine = quarantine
 
 
 class HotSwapManager:
@@ -275,6 +288,39 @@ class HotSwapManager:
             raise ReloadRejected("invariants", "non-finite values in factors")
         report["gates"]["invariants"] = "ok"
 
+    def _gate_capacity(self, model: ALSModel, report: dict) -> None:
+        """Memory-budget admission for the swap itself: during a hot swap
+        TWO generations are device-resident — the incumbent never stops
+        until the candidate's post-swap checks pass — so the candidate must
+        fit *alongside* it, plus a second copy of the exclusion table its
+        batcher uploads. A refusal here is a **recorded rejection, not a
+        quarantine**: the artifact is fine, this process is full."""
+        from albedo_tpu.utils import capacity
+
+        uf, vf = model.user_factors, model.item_factors
+        incumbent = self.service.generation
+        generations = 2 if incumbent.model is not None else 1
+        excl = self.service._exclude_table
+        excl_entries = 0 if excl is None else int(excl.size) * generations
+        plan = capacity.plan_serve(
+            n_users=int(uf.shape[0]), n_items=int(vf.shape[0]),
+            rank=int(model.rank), excl_entries=excl_entries,
+            generations=generations,
+        )
+        verdict = capacity.admit(plan, degradable=False)
+        if verdict.verdict != "fit":
+            raise ReloadRejected(
+                "capacity",
+                f"candidate would not fit alongside the incumbent: "
+                f"{verdict.detail}",
+                quarantine=False,
+            )
+        report["gates"]["capacity"] = {
+            "required_bytes": verdict.required_bytes,
+            "budget_bytes": verdict.budget_bytes,
+            "generations_resident": generations,
+        }
+
     def _gate_probe(self, model: ALSModel, report: dict) -> tuple[np.ndarray, np.ndarray]:
         if not self._probe_dense.size:
             report["gates"]["probe"] = "skipped (no users)"
@@ -312,16 +358,25 @@ class HotSwapManager:
 
     # ------------------------------------------------------------- the swap
 
-    def _reject(self, path: Path, report: dict, gate: str, detail: str) -> dict:
+    def _reject(
+        self, path: Path, report: dict, gate: str, detail: str,
+        quarantine: bool = True,
+    ) -> dict:
         report.update(outcome="rejected", gate=gate, detail=detail)
         self.metrics.reloads.inc(outcome="rejected")
         self.metrics.reload_rejected.inc(gate=gate)
-        events.artifact_corruptions.inc(artifact=path.name)
-        try:
-            quarantined = artifact_store.quarantine(path, reason=f"reload gate {gate}")
-            report["quarantined_to"] = quarantined.name
-        except OSError as e:
-            report["quarantine_error"] = repr(e)
+        if quarantine:
+            events.artifact_corruptions.inc(artifact=path.name)
+            try:
+                quarantined = artifact_store.quarantine(path, reason=f"reload gate {gate}")
+                report["quarantined_to"] = quarantined.name
+            except OSError as e:
+                report["quarantine_error"] = repr(e)
+        else:
+            # A capacity refusal says nothing about the bytes: leave the
+            # artifact in place (recorded, skipped) — quarantine-renaming it
+            # would destroy a healthy model because THIS process was full.
+            report["quarantined_to"] = None
         log.warning("reload rejected at gate %s: %s (%s)", gate, detail, path.name)
         return report
 
@@ -378,9 +433,11 @@ class HotSwapManager:
             candidate_score = self._gate_stamp(path, report)
             model = self._gate_load(path, report)
             self._gate_invariants(model, report)
+            self._gate_capacity(model, report)
             probe_vals, probe_idx = self._gate_probe(model, report)
         except ReloadRejected as e:
-            return self._reject(path, report, e.gate, e.detail)
+            return self._reject(path, report, e.gate, e.detail,
+                                quarantine=e.quarantine)
         except Exception as e:  # noqa: BLE001 — injected ioerror/error kinds land here
             return self._reject(path, report, "load", f"{type(e).__name__}: {e}")
 

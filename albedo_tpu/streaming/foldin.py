@@ -17,7 +17,9 @@ Mechanics mirror the serving micro-batcher (the ALX device-residency
 posture, arxiv 2112.02194):
 
 - touched users' rows are padded to a **(pow2 batch, pow2 length)** shape
-  ladder, so the whole stream runs on a handful of fixed shapes;
+  ladder, so the whole stream runs on a handful of fixed shapes; the ladder
+  stops at the **budgeted rung** (``utils.capacity.max_foldin_entries``):
+  oversized batches split into more, smaller dispatches instead of OOMing;
 - each shape compiles ONCE through ``utils.aot.persistent_aot_executable``
   and the handle is held — the steady-state cycle is ``compiled(...)`` with
   no tracing or cache lookup (regularization and alpha are traced arguments,
@@ -133,6 +135,29 @@ class FoldInEngine:
         self.users_solved = 0
         self.trips = 0
         self.last_batch_s = 0.0
+        # Capacity guardrail: the pow2 shape ladder stops at the budgeted
+        # rung — the largest (bucket * length) slab the device budget admits
+        # alongside the resident item side (utils.capacity). Oversized
+        # batches split into more, smaller dispatches instead of OOMing.
+        # The conservative (length=1) display cap; dispatch decisions use
+        # the per-length rung_cap() below.
+        self.rung_cap_entries = self.rung_cap(1)
+        self.rung_capped = 0  # dispatches shrunk below max_batch by the cap
+
+    def rung_cap(self, length: int) -> int:
+        """Budgeted ``bucket * length`` cap for rungs of this padded length
+        (``utils.capacity.max_foldin_entries``; the per-slot Gramian
+        correction amortizes over the rung length, so longer rungs get a
+        proportionally larger entry budget). ALBEDO_CAPACITY=off disables
+        this guardrail too — the kill switch's contract is "admission
+        entirely off", not "off except the streaming ladder"."""
+        from albedo_tpu.utils import capacity
+
+        if not capacity.enabled():
+            return 1 << 62
+        return capacity.max_foldin_entries(
+            self.rank, int(self._vf.shape[0]), length=length
+        )
 
     # ----------------------------------------------------------- executables
 
@@ -172,11 +197,17 @@ class FoldInEngine:
 
     def warm(self, lengths: tuple[int, ...], buckets: tuple[int, ...] | None = None) -> int:
         """Pre-compile the shape ladder for the given row lengths (pow2-
-        quantized); returns how many executables were prepared."""
+        quantized, capped at the budgeted rung — a shape the capacity cap
+        will never dispatch must not be compiled either); returns how many
+        executables were prepared."""
         buckets = buckets or (self.max_batch,)
         for b in buckets:
             for ln in sorted({_pow2(max(1, int(n))) for n in lengths}):
-                self._executable(_pow2(max(1, int(b))), ln)
+                bb = _pow2(max(1, int(b)))
+                cap = self.rung_cap(ln)
+                while bb > 1 and bb * ln > cap:
+                    bb //= 2
+                self._executable(bb, ln)
         return len(self._executables)
 
     # ----------------------------------------------------------------- solve
@@ -200,10 +231,57 @@ class FoldInEngine:
                 "empty user row passed to fold_in — keep the old factors for "
                 "fully-tombstoned users instead (training-path semantics)"
             )
+        from albedo_tpu.utils import capacity
+
+        # One admission per fold-in call, pricing the rung this call will
+        # ACTUALLY dispatch (nominal worst rung, pre-shrunk to the budgeted
+        # cap — so a permanently tight budget is steady-state `fit`, not a
+        # warning per delta batch). `degrade` then only means something
+        # changed: an armed `oom` at capacity.admit, or a single row too
+        # long for the cap — and the cap drops below this call's rung so
+        # the batch provably splits.
+        nominal_b = _pow2(min(self.max_batch, len(rows)))
+        nominal_l = _pow2(max(int(idx.size) for idx, _ in rows))
+        nominal_cap = self.rung_cap(nominal_l)
+        capped_b = nominal_b
+        while capped_b > 1 and capped_b * nominal_l > nominal_cap:
+            capped_b //= 2
+        verdict = capacity.admit(
+            capacity.plan_foldin(
+                capped_b, nominal_l, self.rank, int(self._vf.shape[0])
+            ),
+            degradable=True,
+        )
+        # degrade_cap < the call's nominal rung forces a visible split; None
+        # = the per-length budget alone governs.
+        degrade_cap = None
+        if verdict.verdict == "degrade":
+            degrade_cap = max(1, (capped_b * nominal_l) // 2)
+            log.warning(
+                "fold-in ladder capped at %d entries (%s)",
+                degrade_cap, verdict.detail,
+            )
         out = np.empty((len(rows), self.rank), dtype=np.float32)
-        for lo in range(0, len(rows), self.max_batch):
-            chunk = rows[lo:lo + self.max_batch]
-            out[lo:lo + len(chunk)] = self._solve_chunk(chunk)
+        i = 0
+        while i < len(rows):
+            take = min(self.max_batch, len(rows) - i)
+            # Shrink the bucket until the padded rung fits the budgeted cap;
+            # a single row always dispatches (its length is not shrinkable —
+            # if even that OOMs for real, the solve itself will say so).
+            while take > 1:
+                b = _pow2(take)
+                ln = _pow2(max(int(idx.size) for idx, _ in rows[i:i + take]))
+                cap = self.rung_cap(ln)
+                if degrade_cap is not None:
+                    cap = min(cap, degrade_cap)
+                if b * ln <= cap:
+                    break
+                take = max(1, take // 2)
+            if take < min(self.max_batch, len(rows) - i):
+                self.rung_capped += 1
+            chunk = rows[i:i + take]
+            out[i:i + len(chunk)] = self._solve_chunk(chunk)
+            i += take
         return out
 
     def _solve_chunk(self, chunk: list[tuple[np.ndarray, np.ndarray]]) -> np.ndarray:

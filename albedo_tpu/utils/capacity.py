@@ -1,0 +1,484 @@
+"""Memory-budget admission: price a workload BEFORE dispatching it.
+
+Until now nothing in the system modeled capacity: the first matrix whose
+bucket slabs out-size a chip's HBM was a raw ``RESOURCE_EXHAUSTED`` crash
+that ``utils.retry`` futilely re-OOMed and ``run_pipeline`` journaled as a
+generic stage failure. ALX (arxiv 2112.02194) makes sharded-beyond-one-chip
+factors the next scale step and iALS++ (arxiv 2110.14044) pushes ranks to
+128/256 — both multiply memory pressure, so exhaustion must become a
+*handled* failure mode before those land.
+
+The planner is a **static cost model from shapes and dtypes**: every
+dispatch seam knows its slab shapes before any byte moves (bucket plans,
+factor-table dims, ladder rungs), so pricing is host arithmetic — no probe
+allocation, no device round-trip. Costs are deliberately coarse (they ignore
+allocator fragmentation and XLA scratch), which is why admission compares
+against a *headroom-scaled* budget and why, where an AOT handle exists, the
+static estimate is cross-checked against the compiler's own
+``compiled.memory_analysis()`` (:func:`compiled_memory_bytes`).
+
+One admission call returns a verdict:
+
+``fit``      the priced bytes fit the budget: dispatch the resident path.
+``degrade``  over budget but the caller declared a degraded mode (chunked
+             host-streamed ALS groups, a lower fold-in ladder rung): take it.
+``refuse``   over budget with no degraded mode (a hot-swap candidate that
+             cannot sit alongside the incumbent): a recorded rejection,
+             never a crash.
+
+Verdicts are counted in ``albedo_capacity_verdicts_total{verdict=,workload=}``.
+The ``capacity.admit`` fault site fires inside every admission; arming the
+new ``oom`` kind forces the over-budget path (the injected
+``RESOURCE_EXHAUSTED`` is caught HERE and converted to degrade/refuse), so
+chaos drills exercise the real degraded machinery without a 16 GB
+allocation.
+
+Budget detection order (per device):
+
+1. ``ALBEDO_DEVICE_MEM_BYTES`` — explicit override, the CPU-CI knob and the
+   chaos-drill pressure valve (suffixes k/m/g accepted).
+2. ``jax.local_devices()[0].memory_stats()["bytes_limit"]`` — what the TPU
+   runtime actually reports.
+3. ``/proc/meminfo`` MemTotal (CPU backends: host RAM is device RAM).
+4. 16 GiB (the v5e figure) when nothing above answers.
+
+``ALBEDO_MEM_HEADROOM`` (default 0.85) scales the detected total into the
+admission budget; ``ALBEDO_CAPACITY=off`` disables admission entirely
+(everything verdicts ``fit`` — the escape hatch if the cost model ever
+refuses a workload that would in fact fit).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+
+import numpy as np
+
+from albedo_tpu.utils import events, faults
+from albedo_tpu.utils.retry import is_resource_exhausted
+
+log = logging.getLogger(__name__)
+
+ADMIT_FAULT = faults.site("capacity.admit")
+
+_ENV_BYTES = "ALBEDO_DEVICE_MEM_BYTES"
+_ENV_HEADROOM = "ALBEDO_MEM_HEADROOM"
+_ENV_TOGGLE = "ALBEDO_CAPACITY"
+_DEFAULT_HEADROOM = 0.85
+_FALLBACK_BYTES = 16 << 30  # v5e per-chip HBM; the "no signal at all" anchor
+
+
+class CapacityExceeded(MemoryError):
+    """An admission verdict of ``refuse`` where the caller cannot proceed at
+    all — carries the verdict so journals/reports can record the pricing.
+
+    Subclasses :class:`MemoryError` ON PURPOSE: ``utils.retry.
+    is_resource_exhausted`` classifies MemoryError as permanent, so a
+    deterministic capacity refusal fails FAST through the pipeline's stage
+    retries instead of re-pricing the identical refusal through the whole
+    backoff budget — the same fail-fast contract a real device OOM gets."""
+
+    def __init__(self, verdict: "AdmissionVerdict"):
+        super().__init__(
+            f"workload {verdict.workload!r} needs ~{verdict.required_bytes:,} "
+            f"bytes against a {verdict.budget_bytes:,}-byte budget "
+            f"(refused: capacity)"
+        )
+        self.verdict = verdict
+
+
+def _parse_bytes(raw: str) -> int:
+    raw = raw.strip().lower()
+    mult = 1
+    if raw and raw[-1] in "kmg":
+        mult = {"k": 1 << 10, "m": 1 << 20, "g": 1 << 30}[raw[-1]]
+        raw = raw[:-1]
+    return int(float(raw) * mult)
+
+
+def enabled() -> bool:
+    return os.environ.get(_ENV_TOGGLE, "on").lower() not in ("off", "0", "false")
+
+
+def device_memory_bytes() -> int:
+    """Detected per-device memory (bytes). See module doc for the order."""
+    raw = os.environ.get(_ENV_BYTES)
+    if raw:
+        return _parse_bytes(raw)
+    try:
+        import jax
+
+        stats = jax.local_devices()[0].memory_stats()
+        if stats and stats.get("bytes_limit"):
+            return int(stats["bytes_limit"])
+    except Exception:  # noqa: BLE001 — detection must never be the crash
+        pass
+    try:
+        with open("/proc/meminfo") as f:
+            for line in f:
+                if line.startswith("MemTotal:"):
+                    return int(line.split()[1]) * 1024
+    except OSError:
+        pass
+    return _FALLBACK_BYTES
+
+
+def headroom() -> float:
+    try:
+        h = float(os.environ.get(_ENV_HEADROOM, _DEFAULT_HEADROOM))
+    except ValueError:
+        h = _DEFAULT_HEADROOM
+    return min(1.0, max(0.05, h))
+
+
+def budget_bytes() -> int:
+    """The admission budget: detected per-device memory x headroom."""
+    return int(device_memory_bytes() * headroom())
+
+
+@dataclasses.dataclass(frozen=True)
+class CapacityPlan:
+    """A priced workload: named byte items summing to ``required_bytes``.
+
+    ``items`` keeps the per-component split (factor tables, slabs, transient
+    gather blocks) so a ``refused: capacity`` journal entry tells the
+    operator WHAT is too big, not just that something is.
+    """
+
+    workload: str
+    items: dict[str, int]
+
+    @property
+    def required_bytes(self) -> int:
+        return int(sum(self.items.values()))
+
+    def to_dict(self) -> dict:
+        return {
+            "workload": self.workload,
+            "required_bytes": self.required_bytes,
+            "items": {k: int(v) for k, v in self.items.items()},
+        }
+
+
+@dataclasses.dataclass(frozen=True)
+class AdmissionVerdict:
+    """The outcome of one admission: verdict + the numbers behind it."""
+
+    workload: str
+    verdict: str  # "fit" | "degrade" | "refuse"
+    required_bytes: int
+    budget_bytes: int
+    detail: str = ""
+    plan: CapacityPlan | None = None
+
+    @property
+    def fits(self) -> bool:
+        return self.verdict == "fit"
+
+    def to_dict(self) -> dict:
+        out = {
+            "workload": self.workload,
+            "verdict": self.verdict,
+            "required_bytes": int(self.required_bytes),
+            "budget_bytes": int(self.budget_bytes),
+            "detail": self.detail,
+        }
+        if self.plan is not None:
+            out["items"] = {k: int(v) for k, v in self.plan.items.items()}
+        return out
+
+
+def admit(
+    plan: CapacityPlan,
+    *,
+    degradable: bool = False,
+    budget: int | None = None,
+    fallback_plan: CapacityPlan | None = None,
+) -> AdmissionVerdict:
+    """Price ``plan`` against the budget and return the verdict.
+
+    ``degradable`` declares that the caller HAS a cheaper mode to fall back
+    to — over-budget then verdicts ``degrade`` instead of ``refuse``. When
+    the fallback itself has a priceable plan, pass it as ``fallback_plan``:
+    a fallback that ALSO busts the budget turns the verdict into ``refuse``
+    — one admission, one counted verdict, never a degrade that could not
+    actually run. The ``capacity.admit`` fault site fires on every
+    admission; an injected ``oom`` is caught here and converted to the
+    over-budget verdict (chaos drives the real degrade path), while other
+    injected kinds propagate like any fault-site error.
+    """
+    budget = budget_bytes() if budget is None else int(budget)
+    required = plan.required_bytes
+    forced = ""
+    if enabled():
+        try:
+            ADMIT_FAULT.hit()
+        except Exception as e:  # noqa: BLE001 — only OOM converts; rest propagate
+            if not is_resource_exhausted(e):
+                raise
+            forced = f" (forced over-budget by injected fault: {e})"
+            required = max(required, budget + 1)
+    over = enabled() and required > budget
+    # An injected oom must land on the DEGRADE path (that is the drill);
+    # only a genuinely over-budget fallback refuses.
+    fallback_fits = fallback_plan is None or forced or (
+        fallback_plan.required_bytes <= budget
+    )
+    if not over:
+        verdict = "fit"
+        detail = f"{required:,} bytes within {budget:,}-byte budget"
+    elif degradable and fallback_fits:
+        verdict = "degrade"
+        detail = (
+            f"{required:,} bytes over the {budget:,}-byte budget; "
+            f"taking the degraded path{forced}"
+        )
+    elif degradable:
+        verdict = "refuse"
+        detail = (
+            f"{required:,} bytes over the {budget:,}-byte budget and the "
+            f"degraded plan needs {fallback_plan.required_bytes:,} bytes "
+            f"itself{forced}"
+        )
+    else:
+        verdict = "refuse"
+        detail = (
+            f"{required:,} bytes over the {budget:,}-byte budget and no "
+            f"degraded mode{forced}"
+        )
+    out = AdmissionVerdict(
+        workload=plan.workload, verdict=verdict, required_bytes=required,
+        budget_bytes=budget, detail=detail, plan=plan,
+    )
+    events.capacity_verdicts.inc(verdict=verdict, workload=plan.workload)
+    if verdict != "fit":
+        log.warning("capacity admission [%s]: %s", plan.workload, detail)
+    return out
+
+
+# --- static cost models -------------------------------------------------------
+# All coarse, all conservative-ish, all pure host arithmetic. f32 = 4 bytes;
+# the gather dtype may halve the streamed block. Each model prices what is
+# RESIDENT for the workload's lifetime plus the single largest transient the
+# program materializes at once.
+
+
+def _dtype_bytes(gather_dtype: str | None) -> int:
+    return 2 if gather_dtype == "bfloat16" else 4
+
+
+def plan_fit(
+    bucket_shapes_user: list[tuple[int, int]],
+    bucket_shapes_item: list[tuple[int, int]],
+    n_users: int,
+    n_items: int,
+    rank: int,
+    gather_dtype: str | None = None,
+) -> CapacityPlan:
+    """Price the device-resident fused ALS fit.
+
+    Resident: both factor tables, every uploaded bucket slab (row_ids + idx
+    + val + mask for BOTH sides — the whole point of the resident path is
+    that ratings stay on device across sweeps), and the landing pools
+    (``concat(solved_blocks..., target)`` materializes ``n_slots + n_target``
+    rank-vectors per half-sweep). Transient: the largest bucket's gathered
+    ``(B, L, rank)`` block plus its ``(B, rank, rank)`` Gramian correction.
+    """
+    gb = _dtype_bytes(gather_dtype)
+    tables = (n_users + n_items) * rank * 4
+    slabs = 0
+    slots_u = slots_i = 0
+    transient = 0
+    for shapes, side in ((bucket_shapes_user, "u"), (bucket_shapes_item, "i")):
+        for b, ln in shapes:
+            slabs += b * 4 + b * ln * (4 + 4 + 1)
+            if side == "u":
+                slots_u += b
+            else:
+                slots_i += b
+            transient = max(transient, b * ln * (rank * gb + gb) + b * rank * rank * 4)
+    landing = (slots_u + n_users + slots_i + n_items) * rank * 4
+    return CapacityPlan(
+        workload="als_fit",
+        items={
+            "factor_tables": tables,
+            "bucket_slabs": slabs,
+            "landing_pools": landing,
+            "transient_gather": transient,
+        },
+    )
+
+
+def plan_fit_chunked(
+    bucket_shapes_user: list[tuple[int, int]],
+    bucket_shapes_item: list[tuple[int, int]],
+    n_users: int,
+    n_items: int,
+    rank: int,
+    gather_dtype: str | None = None,
+) -> CapacityPlan:
+    """Price the chunked host-streamed fallback: only the factor tables stay
+    resident; one bucket's slab + gather block is in flight at a time."""
+    gb = _dtype_bytes(gather_dtype)
+    tables = (n_users + n_items) * rank * 4
+    worst = 0
+    for shapes in (bucket_shapes_user, bucket_shapes_item):
+        for b, ln in shapes:
+            worst = max(
+                worst,
+                b * 4 + b * ln * (4 + 4 + 1)
+                + b * ln * (rank * gb + gb) + b * rank * rank * 4
+                + b * rank * 4,
+            )
+    return CapacityPlan(
+        workload="als_fit_chunked",
+        items={"factor_tables": tables, "worst_bucket_in_flight": worst},
+    )
+
+
+def plan_serve(
+    n_users: int,
+    n_items: int,
+    rank: int,
+    excl_entries: int = 0,
+    generations: int = 1,
+) -> CapacityPlan:
+    """Price ``generations`` device-resident serving generations.
+
+    A generation pins both factor tables (``ALSModel.device_factors``) plus
+    the -1-padded exclusion table (int32 per entry). During a hot swap TWO
+    generations are resident — the incumbent never stops until the candidate
+    passes its post-swap checks — which is exactly the pressure the reload
+    capacity gate admits against.
+    """
+    per_gen = (n_users + n_items) * rank * 4
+    return CapacityPlan(
+        workload="serve",
+        items={
+            "factor_tables": per_gen * max(1, generations),
+            "exclusion_table": int(excl_entries) * 4,
+        },
+    )
+
+
+def plan_foldin(
+    bucket: int,
+    length: int,
+    rank: int,
+    n_items: int,
+) -> CapacityPlan:
+    """Price one fold-in ladder rung: the frozen item side (factors +
+    Gramian, resident across every batch) plus the rung's padded slab and
+    its gathered block."""
+    item_side = n_items * rank * 4 + rank * rank * 4
+    slab = bucket * length * (4 + 4 + 1)
+    gathered = bucket * length * rank * 4 + bucket * rank * rank * 4
+    return CapacityPlan(
+        workload="foldin",
+        items={
+            "frozen_item_side": item_side,
+            "rung_slab": slab,
+            "rung_gather": gathered,
+        },
+    )
+
+
+def max_foldin_entries(
+    rank: int, n_items: int, budget: int | None = None, length: int = 1
+) -> int:
+    """The largest ``bucket * length`` product whose fold-in rung fits the
+    budget — the cap on the pow2 shape ladder, for rungs of the given
+    ``length``. Returns at least 1 (a single short row must always be
+    dispatchable; if even that OOMs for real, the solve itself will say so).
+
+    Per-entry bytes must cover everything ``plan_foldin`` prices, or a rung
+    shrunk to this cap would still admit over-budget: slab (idx+val+mask)
+    + gathered rank-vector + the per-SLOT ``(B, rank, rank)`` Gramian
+    correction, which amortizes as ``rank^2*4 / length`` per entry. The
+    default ``length=1`` is the conservative floor — a caller that knows
+    its rung's padded length passes it and gets a proportionally larger
+    cap; one that doesn't never under-prices a batch of 1-star rows."""
+    budget = budget_bytes() if budget is None else int(budget)
+    item_side = n_items * rank * 4 + rank * rank * 4
+    per_entry = (4 + 4 + 1) + rank * 4 + (rank * rank * 4) // max(1, int(length))
+    spare = budget - item_side
+    if spare <= per_entry:
+        return 1
+    return max(1, int(spare // per_entry))
+
+
+# --- compiler cross-check -----------------------------------------------------
+
+
+def compiled_memory_bytes(compiled) -> dict | None:
+    """Best-effort read of an AOT executable's own memory analysis.
+
+    Returns ``{argument, output, temp, generated_code, total}`` bytes or
+    ``None`` when the backend doesn't expose ``memory_analysis()`` (older
+    jaxlib, some CPU builds). Callers use it to cross-check the static model
+    — a static estimate wildly below the compiler's own number means the
+    model went stale, and the larger figure should drive admission."""
+    try:
+        ma = compiled.memory_analysis()
+        if ma is None:
+            return None
+        out = {
+            "argument": int(getattr(ma, "argument_size_in_bytes", 0)),
+            "output": int(getattr(ma, "output_size_in_bytes", 0)),
+            "temp": int(getattr(ma, "temp_size_in_bytes", 0)),
+            "generated_code": int(getattr(ma, "generated_code_size_in_bytes", 0)),
+        }
+        alias = int(getattr(ma, "alias_size_in_bytes", 0))
+        out["total"] = max(0, sum(out.values()) - alias)
+        return out
+    except Exception:  # noqa: BLE001 — advisory only
+        return None
+
+
+def cross_check(plan: CapacityPlan, compiled) -> dict | None:
+    """Compare the static plan against the compiler's memory analysis.
+
+    Advisory: returns the comparison record (logged when the static model
+    underestimates by >2x) or None when no analysis is available."""
+    analysis = compiled_memory_bytes(compiled)
+    if analysis is None or not analysis.get("total"):
+        return None
+    static = plan.required_bytes
+    ratio = analysis["total"] / max(1, static)
+    record = {
+        "static_bytes": static,
+        "compiled_bytes": analysis["total"],
+        "ratio": round(ratio, 3),
+        "analysis": analysis,
+    }
+    # Warn only on MATERIAL underestimates: tiny programs carry fixed XLA
+    # temp overheads that dwarf their slabs (ratio noise at KB scale), and
+    # a model off by a few hundred KB cannot mis-admit anything.
+    if ratio > 2.0 and analysis["total"] - static > 64 << 20:
+        log.warning(
+            "capacity model underestimates %s: static %s bytes vs compiler "
+            "%s bytes (%.1fx) — admission should trust the larger figure",
+            plan.workload, f"{static:,}", f"{analysis['total']:,}", ratio,
+        )
+    return record
+
+
+def bucket_plan_shapes(indptr: np.ndarray, **layout_kwargs) -> list[tuple[int, int]]:
+    """Shapes ``(B, L)`` the bucket planner would allocate for this CSR/CSC
+    side — the pricing input, computed WITHOUT filling any slab."""
+    from albedo_tpu.datasets.ragged import plan_buckets
+
+    return [p.shape for p in plan_buckets(indptr, **layout_kwargs)]
+
+
+def counts_indptr(row_ids: np.ndarray, n_rows: int) -> np.ndarray:
+    """An indptr from bare row ids — all the planner needs. Pricing must
+    not pay the O(nnz log nnz) argsort a full ``matrix.csr()``/``csc()``
+    view costs just to read row lengths (the cold path sorts them again
+    for real minutes later)."""
+    counts = np.bincount(np.asarray(row_ids), minlength=n_rows)
+    indptr = np.zeros(n_rows + 1, dtype=np.int64)
+    np.cumsum(counts, out=indptr[1:])
+    return indptr

@@ -278,3 +278,16 @@ stream_publishes = global_counter(
     "outcome.",
     ("outcome",),
 )
+# The capacity guardrail plane (PR 7): admission verdicts at every dispatch
+# seam and degraded-mesh boots.
+capacity_verdicts = global_counter(
+    "albedo_capacity_verdicts_total",
+    "Memory-budget admission verdicts (utils.capacity), by verdict "
+    "(fit/degrade/refuse) and workload (als_fit/serve/foldin/...).",
+    ("verdict", "workload"),
+)
+mesh_degraded = global_counter(
+    "albedo_mesh_degraded_total",
+    "Mesh constructions that remeshed to fewer devices than requested "
+    "(device loss or an injected mesh.devices fault).",
+)

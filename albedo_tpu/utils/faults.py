@@ -17,6 +17,11 @@ kind        effect at the Nth hit
 ``kill``    ``os._exit(137)`` — a hard SIGKILL-style preemption, no cleanup
 ``term``    ``os.kill(os.getpid(), SIGTERM)`` — a polite preemption notice,
             exercising the SIGTERM checkpoint-and-exit path
+``oom``     raise :class:`InjectedResourceExhausted` — a stand-in for the
+            ``XlaRuntimeError: RESOURCE_EXHAUSTED`` a real over-HBM
+            allocation throws (``utils.retry.is_resource_exhausted``
+            classifies both as permanent; ``utils.capacity.admit`` converts
+            one fired at ``capacity.admit`` into an over-budget verdict)
 ==========  ================================================================
 
 Arming is programmatic (``faults.site("artifact.load").arm(kind="corrupt")``)
@@ -35,7 +40,8 @@ code by ``tests/test_fault_sites.py``): ``artifact.load``,
 ``artifact.save``, ``checkpoint.save``, ``checkpoint.restore``,
 ``crawler.transport``, ``pipeline.stage``, ``pipeline.stage.<name>``,
 ``serving.source.<name>``, ``serving.rank``, ``serving.breaker.<name>``,
-``reload.load``, ``reload.validate``.
+``reload.load``, ``reload.validate``, ``capacity.admit``, ``mesh.devices``,
+``als.chunked``.
 """
 
 from __future__ import annotations
@@ -50,11 +56,17 @@ from pathlib import Path
 from albedo_tpu.utils import events
 
 _ENV_VAR = "ALBEDO_FAULTS"
-KINDS = ("error", "ioerror", "corrupt", "delay", "kill", "term")
+KINDS = ("error", "ioerror", "corrupt", "delay", "kill", "term", "oom")
 
 
 class FaultInjected(RuntimeError):
     """The generic injected failure (kind=error)."""
+
+
+class InjectedResourceExhausted(MemoryError):
+    """The injected OOM (kind=oom): message and classification match what a
+    real ``XlaRuntimeError: RESOURCE_EXHAUSTED`` looks like to the retry
+    predicates, without this module importing jax."""
 
 
 @dataclasses.dataclass
@@ -212,6 +224,11 @@ class FaultRegistry:
             return
         if spec.kind == "ioerror":
             raise OSError(f"injected IOError at fault site {site!r}")
+        if spec.kind == "oom":
+            raise InjectedResourceExhausted(
+                f"RESOURCE_EXHAUSTED: injected out-of-memory at fault site "
+                f"{site!r} (simulated over-HBM allocation)"
+            )
         raise FaultInjected(f"injected fault at site {site!r}")
 
 

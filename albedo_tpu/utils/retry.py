@@ -32,6 +32,41 @@ from typing import Any, Callable
 from albedo_tpu.utils import events
 
 
+# Substrings a device OOM carries, across backends and jax versions. An
+# XlaRuntimeError's class lives deep in jaxlib and moves between releases, so
+# classification is by name + message — which also covers the fault harness's
+# InjectedResourceExhausted (a MemoryError) without importing jax here.
+_RESOURCE_EXHAUSTED_MARKERS = (
+    "RESOURCE_EXHAUSTED", "RESOURCE EXHAUSTED", "Out of memory",
+    "out of memory", "OOM",
+)
+
+
+def is_resource_exhausted(exc: BaseException) -> bool:
+    """True for device/host OOM-shaped failures: ``MemoryError``, an
+    ``XlaRuntimeError`` (by class name — jaxlib moves it between modules)
+    whose message says RESOURCE_EXHAUSTED/out-of-memory, or the fault
+    harness's injected OOM. These are PERMANENT for retry purposes: the
+    same allocation re-OOMs the same device, so backoff burns the whole
+    budget re-crashing — the caller must fail fast to the degrade path
+    (``utils.capacity``) instead."""
+    if isinstance(exc, MemoryError):
+        return True
+    msg = str(exc)
+    name = type(exc).__name__
+    if name == "XlaRuntimeError" or "XlaRuntimeError" in name:
+        return any(m in msg for m in _RESOURCE_EXHAUSTED_MARKERS)
+    return any(m in msg for m in _RESOURCE_EXHAUSTED_MARKERS[:2])
+
+
+def default_retry_predicate(exc: BaseException) -> bool:
+    """The shared baseline predicate: any Exception retries EXCEPT
+    resource exhaustion (see :func:`is_resource_exhausted`). Callers with
+    their own predicate should compose it:
+    ``lambda e: my_check(e) and default_retry_predicate(e)``."""
+    return not is_resource_exhausted(exc)
+
+
 class RetryAfter(Exception):
     """An attempt failed but the server supplied the wait: honor it.
 
@@ -101,9 +136,11 @@ def retry_call(
 ) -> Any:
     """Call ``fn()`` until it returns, the predicate rejects, or budget ends.
 
-    - ``retry_on(exc)`` decides retryability (default: any Exception);
-      non-retryable exceptions propagate unchanged. :class:`RetryAfter` is
-      always retryable and carries its own delay.
+    - ``retry_on(exc)`` decides retryability (default:
+      :func:`default_retry_predicate` — any Exception EXCEPT resource
+      exhaustion, which re-OOMs identically and must fail fast to the
+      capacity degrade path); non-retryable exceptions propagate unchanged.
+      :class:`RetryAfter` is always retryable and carries its own delay.
     - ``on_retry(attempt, exc, delay_s)`` observes each scheduled retry.
     - Exhaustion raises :class:`RetriesExhausted` from the last exception.
 
@@ -120,7 +157,8 @@ def retry_call(
             last = e
             delay = e.delay_s
         except Exception as e:  # noqa: BLE001 — predicate decides
-            if retry_on is not None and not retry_on(e):
+            predicate = retry_on if retry_on is not None else default_retry_predicate
+            if not predicate(e):
                 raise
             last = e
             delay = policy.delay(attempt, rng)

@@ -1457,10 +1457,92 @@ def foldin_bench() -> dict:
     }
 
 
+def capacity_bench() -> dict:
+    """The `capacity` scenario: chunked-fallback overhead vs the resident
+    path.
+
+    The capacity layer's `degrade` verdict trades throughput for survival:
+    the chunked host-streamed fit re-uploads every bucket slab per
+    half-sweep instead of keeping them device-resident. This scenario
+    measures that trade on one matrix — interleaved A/B trials
+    (resident/chunked), median fit wall-clock each, per the bench-box
+    throttling policy — so the ROADMAP's scale items know what a degraded
+    single-chip fit actually costs. Both arms are warmed once (layout +
+    executables) so the medians compare steady-state fits, not compiles.
+    Env knobs: ALBEDO_CAPACITY_USERS/ITEMS/MEAN_STARS/ITERS/TRIALS/RANK.
+    """
+    import statistics
+
+    import jax
+    import numpy as np
+
+    from albedo_tpu.datasets.synthetic import synthetic_stars
+    from albedo_tpu.models.als import ImplicitALS
+    from albedo_tpu.utils import capacity
+
+    n_users = int(os.environ.get("ALBEDO_CAPACITY_USERS", "2000"))
+    n_items = int(os.environ.get("ALBEDO_CAPACITY_ITEMS", "1200"))
+    mean_stars = float(os.environ.get("ALBEDO_CAPACITY_MEAN_STARS", "20"))
+    iters = int(os.environ.get("ALBEDO_CAPACITY_ITERS", "4"))
+    trials = int(os.environ.get("ALBEDO_CAPACITY_TRIALS", "5"))
+    rank = int(os.environ.get("ALBEDO_CAPACITY_RANK", "16"))
+
+    matrix = synthetic_stars(
+        n_users=n_users, n_items=n_items, mean_stars=mean_stars, seed=42
+    )
+    kw = dict(rank=rank, max_iter=iters, seed=0)
+    resident_est = ImplicitALS(**kw, chunked=False)
+    chunked_est = ImplicitALS(**kw, chunked=True)
+    plan = resident_est.capacity_plan(matrix)
+    chunked_plan = resident_est.capacity_plan(matrix, chunked=True)
+
+    def run(est: ImplicitALS) -> tuple[float, "np.ndarray"]:
+        t0 = time.perf_counter()
+        model = est.fit(matrix)
+        uf = model.user_factors  # forces the d2h read; fit already synced
+        return time.perf_counter() - t0, uf
+
+    # Warm both arms (layout cache, executables), checking parity once.
+    _, uf_res = run(resident_est)
+    _, uf_chg = run(chunked_est)
+    max_delta = float(np.max(np.abs(uf_res - uf_chg)))
+    if not (max_delta < 1e-3 and np.isfinite(uf_chg).all()):
+        fail("capacity", f"chunked/resident parity broke: max delta {max_delta}")
+
+    res_trials, chk_trials = [], []
+    for _ in range(max(1, trials)):
+        res_trials.append(run(resident_est)[0])
+        chk_trials.append(run(chunked_est)[0])
+    resident_s = statistics.median(res_trials)
+    chunked_s = statistics.median(chk_trials)
+    return {
+        "metric": "chunked_fallback_overhead",
+        "unit": "chunked/resident fit wall-clock ratio",
+        "value": round(chunked_s / max(resident_s, 1e-9), 3),
+        "resident_fit_s_median": round(resident_s, 4),
+        "chunked_fit_s_median": round(chunked_s, 4),
+        "trials": {
+            "resident_s": [round(t, 4) for t in res_trials],
+            "chunked_s": [round(t, 4) for t in chk_trials],
+        },
+        "parity_max_abs_delta": max_delta,
+        "plan_resident_bytes": plan.required_bytes,
+        "plan_chunked_bytes": chunked_plan.required_bytes,
+        "detected_budget_bytes": capacity.budget_bytes(),
+        "backend": jax.default_backend(),
+        "n_users": n_users,
+        "n_items": n_items,
+        "nnz": int(matrix.nnz),
+        "rank": rank,
+        "iters": iters,
+    }
+
+
 SCENARIOS = {
     "serving": serving_bench,
     "datacheck": datacheck_bench,
     "foldin": foldin_bench,
+    "capacity": capacity_bench,
 }
 
 

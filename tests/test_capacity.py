@@ -1,0 +1,286 @@
+"""Memory-budget admission (utils.capacity): detection, pricing, verdicts,
+the oom fault conversion, the compiler cross-check, and the OOM-permanent
+retry classification (the fail-fast-to-degrade contract)."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from albedo_tpu.utils import capacity, events, faults  # noqa: E402
+from albedo_tpu.utils.faults import InjectedResourceExhausted  # noqa: E402
+from albedo_tpu.utils.retry import (  # noqa: E402
+    RetriesExhausted,
+    RetryPolicy,
+    default_retry_predicate,
+    is_resource_exhausted,
+    retry_call,
+)
+
+
+# --- detection ----------------------------------------------------------------
+
+
+class TestDetection:
+    def test_env_override_wins(self, monkeypatch):
+        monkeypatch.setenv("ALBEDO_DEVICE_MEM_BYTES", "123456")
+        assert capacity.device_memory_bytes() == 123456
+
+    def test_env_override_suffixes(self, monkeypatch):
+        monkeypatch.setenv("ALBEDO_DEVICE_MEM_BYTES", "2g")
+        assert capacity.device_memory_bytes() == 2 << 30
+        monkeypatch.setenv("ALBEDO_DEVICE_MEM_BYTES", "512m")
+        assert capacity.device_memory_bytes() == 512 << 20
+
+    def test_detection_without_env_is_positive(self, monkeypatch):
+        monkeypatch.delenv("ALBEDO_DEVICE_MEM_BYTES", raising=False)
+        # CPU CI: memory_stats is absent -> /proc/meminfo or the fallback.
+        assert capacity.device_memory_bytes() > 1 << 20
+
+    def test_budget_applies_headroom(self, monkeypatch):
+        monkeypatch.setenv("ALBEDO_DEVICE_MEM_BYTES", "1000000")
+        monkeypatch.setenv("ALBEDO_MEM_HEADROOM", "0.5")
+        assert capacity.budget_bytes() == 500000
+
+    def test_capacity_off_switch(self, monkeypatch):
+        monkeypatch.setenv("ALBEDO_DEVICE_MEM_BYTES", "10")
+        monkeypatch.setenv("ALBEDO_CAPACITY", "off")
+        plan = capacity.CapacityPlan("x", {"stuff": 10**12})
+        assert capacity.admit(plan).verdict == "fit"
+
+
+# --- pricing ------------------------------------------------------------------
+
+
+class TestPlans:
+    def test_plan_fit_items_and_monotonicity(self):
+        small = capacity.plan_fit([(8, 16)], [(8, 16)], 100, 50, 8)
+        big = capacity.plan_fit([(64, 128)], [(64, 128)], 100, 50, 8)
+        assert set(small.items) == {
+            "factor_tables", "bucket_slabs", "landing_pools", "transient_gather",
+        }
+        assert 0 < small.required_bytes < big.required_bytes
+        # bf16 gathers stream fewer bytes.
+        bf16 = capacity.plan_fit([(64, 128)], [(64, 128)], 100, 50, 8, "bfloat16")
+        assert bf16.required_bytes < big.required_bytes
+
+    def test_chunked_plan_is_cheaper_than_resident(self):
+        shapes = [(64, 64), (32, 128), (128, 16)]
+        resident = capacity.plan_fit(shapes, shapes, 500, 300, 16)
+        chunked = capacity.plan_fit_chunked(shapes, shapes, 500, 300, 16)
+        assert chunked.required_bytes < resident.required_bytes
+
+    def test_plan_serve_scales_with_generations(self):
+        one = capacity.plan_serve(1000, 500, 16, excl_entries=100, generations=1)
+        two = capacity.plan_serve(1000, 500, 16, excl_entries=100, generations=2)
+        assert two.items["factor_tables"] == 2 * one.items["factor_tables"]
+
+    def test_max_foldin_entries_monotone_in_budget(self):
+        lo = capacity.max_foldin_entries(16, 1000, budget=100_000)
+        hi = capacity.max_foldin_entries(16, 1000, budget=1_000_000)
+        assert 1 <= lo < hi
+
+    def test_max_foldin_entries_floor_is_one(self):
+        assert capacity.max_foldin_entries(16, 10**6, budget=10) == 1
+
+    def test_max_foldin_entries_longer_rungs_amortize_the_gramian(self):
+        # The per-slot (B, rank, rank) correction amortizes over length: a
+        # longer rung gets a larger entry budget, and the default length=1
+        # is the conservative floor (never under-prices 1-star rows).
+        short = capacity.max_foldin_entries(50, 1000, budget=10_000_000)
+        long_ = capacity.max_foldin_entries(50, 1000, budget=10_000_000, length=64)
+        assert short < long_
+
+    def test_bucket_plan_shapes_match_planner(self):
+        from albedo_tpu.datasets.ragged import plan_buckets
+        from albedo_tpu.datasets.synthetic import synthetic_stars
+
+        m = synthetic_stars(n_users=80, n_items=40, mean_stars=6, seed=0)
+        indptr = m.csr()[0]
+        shapes = capacity.bucket_plan_shapes(indptr, batch_size=16)
+        assert shapes == [p.shape for p in plan_buckets(indptr, batch_size=16)]
+        assert all(b >= 1 and ln >= 1 for b, ln in shapes)
+
+
+# --- admission ----------------------------------------------------------------
+
+
+class TestAdmit:
+    def test_fit_within_budget(self):
+        v = capacity.admit(capacity.CapacityPlan("w", {"a": 10}), budget=100)
+        assert v.verdict == "fit" and v.fits
+
+    def test_degrade_when_degradable(self):
+        v = capacity.admit(
+            capacity.CapacityPlan("w", {"a": 1000}), budget=100, degradable=True
+        )
+        assert v.verdict == "degrade"
+
+    def test_refuse_when_not_degradable(self):
+        v = capacity.admit(capacity.CapacityPlan("w", {"a": 1000}), budget=100)
+        assert v.verdict == "refuse"
+
+    def test_verdicts_counted(self):
+        before = events.capacity_verdicts.value(verdict="refuse", workload="w")
+        capacity.admit(capacity.CapacityPlan("w", {"a": 1000}), budget=100)
+        assert events.capacity_verdicts.value(
+            verdict="refuse", workload="w"
+        ) == before + 1
+
+    def test_armed_oom_forces_over_budget_not_crash(self):
+        faults.arm("capacity.admit", kind="oom", at=1)
+        v = capacity.admit(
+            capacity.CapacityPlan("w", {"a": 1}), budget=10**9, degradable=True
+        )
+        assert v.verdict == "degrade"
+        assert "injected" in v.detail
+
+    def test_armed_error_kind_still_propagates(self):
+        # Only OOM converts to a verdict; other kinds are real failures.
+        faults.arm("capacity.admit", kind="error", at=1)
+        with pytest.raises(faults.FaultInjected):
+            capacity.admit(capacity.CapacityPlan("w", {"a": 1}), budget=10**9)
+
+    def test_capacity_exceeded_message_carries_pricing(self):
+        v = capacity.admit(capacity.CapacityPlan("w", {"a": 1000}), budget=100)
+        err = capacity.CapacityExceeded(v)
+        assert "refused: capacity" in str(err)
+        assert err.verdict.required_bytes == 1000
+
+    def test_capacity_exceeded_is_retry_permanent(self):
+        # A deterministic refusal must fail FAST through the pipeline's
+        # stage retries — same contract as a real device OOM.
+        v = capacity.admit(capacity.CapacityPlan("w", {"a": 1000}), budget=100)
+        assert is_resource_exhausted(capacity.CapacityExceeded(v))
+        assert not default_retry_predicate(capacity.CapacityExceeded(v))
+
+
+# --- compiler cross-check -----------------------------------------------------
+
+
+class TestCrossCheck:
+    def test_cross_check_on_real_executable(self):
+        import jax.numpy as jnp
+
+        compiled = jax.jit(lambda x: x @ x.T).lower(
+            jnp.zeros((64, 32), jnp.float32)
+        ).compile()
+        analysis = capacity.compiled_memory_bytes(compiled)
+        if analysis is None:
+            pytest.skip("backend exposes no memory_analysis")
+        assert analysis["total"] >= 0
+        record = capacity.cross_check(
+            capacity.CapacityPlan("x", {"a": max(1, analysis["total"])}), compiled
+        )
+        assert record is None or record["ratio"] <= 2.0
+
+    def test_cross_check_tolerates_garbage_handle(self):
+        assert capacity.compiled_memory_bytes(object()) is None
+        assert capacity.cross_check(capacity.CapacityPlan("x", {"a": 1}), object()) is None
+
+
+# --- the OOM retry classification (satellite) ---------------------------------
+
+
+class TestResourceExhaustedClassification:
+    def test_injected_oom_is_resource_exhausted(self):
+        exc = InjectedResourceExhausted("RESOURCE_EXHAUSTED: injected")
+        assert is_resource_exhausted(exc)
+        assert not default_retry_predicate(exc)
+
+    def test_memoryerror_is_permanent(self):
+        assert is_resource_exhausted(MemoryError("oom"))
+
+    def test_xla_shaped_error_by_name_and_message(self):
+        XlaRuntimeError = type("XlaRuntimeError", (RuntimeError,), {})
+        assert is_resource_exhausted(
+            XlaRuntimeError("RESOURCE_EXHAUSTED: Out of memory allocating 1g")
+        )
+        assert not is_resource_exhausted(XlaRuntimeError("INVALID_ARGUMENT"))
+
+    def test_ordinary_errors_stay_retryable(self):
+        assert default_retry_predicate(OSError("flaky disk"))
+        assert default_retry_predicate(RuntimeError("transient"))
+
+    def test_retry_call_fails_fast_on_oom_by_default(self):
+        calls = []
+
+        def attempt():
+            calls.append(1)
+            raise InjectedResourceExhausted("RESOURCE_EXHAUSTED: boom")
+
+        with pytest.raises(InjectedResourceExhausted):
+            retry_call(
+                attempt, policy=RetryPolicy(max_attempts=5, jitter=False),
+                sleeper=lambda s: None, site="t",
+            )
+        assert len(calls) == 1  # no backoff budget burned re-OOMing
+
+    def test_retry_call_still_retries_transients(self):
+        calls = []
+
+        def attempt():
+            calls.append(1)
+            raise OSError("flaky")
+
+        with pytest.raises(RetriesExhausted):
+            retry_call(
+                attempt, policy=RetryPolicy(max_attempts=3, jitter=False),
+                sleeper=lambda s: None, site="t",
+            )
+        assert len(calls) == 3
+
+    def test_oom_fault_kind_fires_and_counts(self):
+        faults.arm("x.site", kind="oom", at=1)
+        with pytest.raises(InjectedResourceExhausted) as ei:
+            faults.hit("x.site")
+        assert "RESOURCE_EXHAUSTED" in str(ei.value)
+        assert faults.FAULTS.fired("x.site") == 1
+
+    def test_oom_kind_parses_from_env(self):
+        reg = faults.FaultRegistry(env="a.b:oom@2")
+        reg.hit("a.b")
+        with pytest.raises(InjectedResourceExhausted):
+            reg.hit("a.b")
+
+
+# --- end to end: admission drives the estimator -------------------------------
+
+
+class TestEstimatorAdmission:
+    def test_admission_fit_verdict_on_roomy_budget(self, monkeypatch):
+        from albedo_tpu.datasets.synthetic import synthetic_stars
+        from albedo_tpu.models.als import ImplicitALS
+
+        monkeypatch.setenv("ALBEDO_DEVICE_MEM_BYTES", "4g")
+        m = synthetic_stars(n_users=60, n_items=40, mean_stars=5, seed=0)
+        assert ImplicitALS(rank=8, batch_size=16).admission(m).verdict == "fit"
+
+    def test_admission_refuses_when_even_chunked_is_over(self, monkeypatch):
+        from albedo_tpu.datasets.synthetic import synthetic_stars
+        from albedo_tpu.models.als import ImplicitALS
+
+        m = synthetic_stars(n_users=60, n_items=40, mean_stars=5, seed=0)
+        est = ImplicitALS(rank=8, batch_size=16)
+        chunked = est.capacity_plan(m, chunked=True)
+        monkeypatch.setenv(
+            "ALBEDO_DEVICE_MEM_BYTES", str(chunked.required_bytes // 2)
+        )
+        with pytest.raises(capacity.CapacityExceeded):
+            est.admission(m)
+
+    def test_fit_report_records_verdict(self, monkeypatch):
+        from albedo_tpu.datasets.synthetic import synthetic_stars
+        from albedo_tpu.models.als import ImplicitALS
+
+        monkeypatch.setenv("ALBEDO_DEVICE_MEM_BYTES", "4g")
+        m = synthetic_stars(n_users=60, n_items=40, mean_stars=5, seed=0)
+        est = ImplicitALS(rank=8, max_iter=1, batch_size=16)
+        est.fit(m)
+        assert est.last_fit_report["mode"] == "resident"
+        assert est.last_fit_report["capacity"]["verdict"] == "fit"
+        assert np.isfinite(est.last_fit_report["health"]["rms"])
+        # The compiler cross-check rode along (None only when the backend
+        # exposes no memory_analysis).
+        cross = est.last_fit_report["capacity_cross_check"]
+        assert cross is None or cross["compiled_bytes"] > 0

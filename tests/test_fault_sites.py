@@ -88,5 +88,6 @@ def test_known_sites_are_present():
         "serving.breaker.<name>", "reload.load", "reload.validate",
         "data.validate", "train.watchdog", "pipeline.canary",
         "stream.ingest", "stream.foldin", "stream.drift",
+        "capacity.admit", "mesh.devices", "als.chunked",
     ):
         assert site in code, f"expected fault site {site!r} not found in code"

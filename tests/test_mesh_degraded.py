@@ -1,0 +1,103 @@
+"""Degraded-mesh operation: the 8 -> 4 -> 2 -> 1 remesh ladder when fewer
+devices are visible than requested (startup shortfall or an injected
+``mesh.devices`` device-loss fault), and the factors-are-identical contract
+for fits on a degraded mesh."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from albedo_tpu.datasets.synthetic import synthetic_stars  # noqa: E402
+from albedo_tpu.models.als import ImplicitALS  # noqa: E402
+from albedo_tpu.parallel.mesh import (  # noqa: E402
+    DATA_AXIS,
+    ITEM_AXIS,
+    degraded_ladder,
+    make_mesh,
+)
+from albedo_tpu.utils import events, faults  # noqa: E402
+
+
+class TestLadder:
+    @pytest.mark.parametrize(
+        "requested,available,item,expect",
+        [
+            (8, 8, 1, 8),
+            (16, 8, 1, 8),
+            (8, 4, 1, 4),
+            (8, 3, 1, 2),
+            (8, 1, 1, 1),
+            (8, 4, 2, 4),
+            (8, 3, 2, 2),
+            (1, 1, 1, 1),
+        ],
+    )
+    def test_ladder(self, requested, available, item, expect):
+        assert degraded_ladder(requested, available, item=item) == expect
+
+    def test_never_below_one(self):
+        assert degraded_ladder(64, 0, item=4) == 1
+
+
+class TestMakeMesh:
+    def test_full_request_unchanged(self):
+        mesh = make_mesh(8)
+        assert mesh.shape[DATA_AXIS] == 8 and mesh.shape[ITEM_AXIS] == 1
+
+    def test_oversized_request_degrades_loudly(self):
+        before = events.mesh_degraded.total()
+        mesh = make_mesh(16)  # the CI box forces 8 virtual devices
+        assert mesh.shape[DATA_AXIS] * mesh.shape[ITEM_AXIS] == 8
+        assert events.mesh_degraded.total() == before + 1
+
+    def test_degraded_remesh_disabled_raises(self):
+        with pytest.raises(ValueError, match="degraded remesh disabled"):
+            make_mesh(16, allow_degraded=False)
+
+    def test_device_loss_fault_halves_the_mesh(self):
+        faults.arm("mesh.devices", kind="error", at=1)
+        before = events.mesh_degraded.total()
+        mesh = make_mesh(8, data=4, item=2)
+        assert mesh.shape[DATA_AXIS] * mesh.shape[ITEM_AXIS] == 4
+        assert mesh.shape[ITEM_AXIS] == 2  # item axis survives when it divides
+        assert events.mesh_degraded.total() == before + 1
+        assert faults.FAULTS.fired("mesh.devices") == 1
+
+    def test_item_axis_collapses_when_it_no_longer_divides(self):
+        # 8 requested with item=8, only 4 visible: 4 % 8 != 0 -> item -> 1.
+        faults.arm("mesh.devices", kind="error", at=1)
+        mesh = make_mesh(8, data=1, item=8)
+        assert mesh.shape[ITEM_AXIS] == 1
+        assert mesh.shape[DATA_AXIS] * mesh.shape[ITEM_AXIS] == 4
+
+    def test_oom_kind_also_reads_as_device_loss(self):
+        faults.arm("mesh.devices", kind="oom", at=1)
+        mesh = make_mesh(8)
+        assert mesh.shape[DATA_AXIS] * mesh.shape[ITEM_AXIS] == 4
+
+    def test_explicit_shape_mismatch_still_errors(self):
+        with pytest.raises(ValueError, match="!="):
+            make_mesh(8, data=3, item=2)
+
+
+class TestDegradedFitParity:
+    def test_degraded_mesh_reaches_the_same_factors(self):
+        """The multichip drill's contract, in-suite: half the slice drops
+        out, the remeshed fit is slower but lands the SAME factors."""
+        matrix = synthetic_stars(n_users=64, n_items=48, mean_stars=6, seed=3)
+        kw = dict(rank=8, max_iter=2, batch_size=32, seed=0)
+        full = ImplicitALS(**kw, mesh=make_mesh(8)).fit(matrix)
+
+        faults.arm("mesh.devices", kind="error", at=1)
+        degraded_mesh = make_mesh(8)
+        assert degraded_mesh.shape[DATA_AXIS] == 4
+        matrix2 = synthetic_stars(n_users=64, n_items=48, mean_stars=6, seed=3)
+        degraded = ImplicitALS(**kw, mesh=degraded_mesh).fit(matrix2)
+
+        np.testing.assert_allclose(
+            degraded.user_factors, full.user_factors, atol=1e-5
+        )
+        np.testing.assert_allclose(
+            degraded.item_factors, full.item_factors, atol=1e-5
+        )

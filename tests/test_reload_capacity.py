@@ -1,0 +1,155 @@
+"""Hot-swap under memory pressure: the reload capacity gate refuses a
+candidate that would not fit alongside the incumbent — recorded, counted
+under ``gate=capacity``, and NOT quarantined; the incumbent keeps serving.
+The satellite drill runs over real HTTP."""
+
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from albedo_tpu.datasets import synthetic_tables  # noqa: E402
+from albedo_tpu.datasets.artifacts import artifact_path, save_pickle, write_manifest  # noqa: E402
+from albedo_tpu.models.als import ALSModel, ImplicitALS  # noqa: E402
+from albedo_tpu.serving import HotSwapManager, RecommendationService, serve  # noqa: E402
+from albedo_tpu.utils import events  # noqa: E402
+
+K = 8
+
+
+@pytest.fixture(scope="module")
+def artifacts():
+    tables = synthetic_tables(n_users=80, n_items=50, mean_stars=6, seed=23)
+    matrix = tables.star_matrix()
+    model_a = ImplicitALS(rank=8, max_iter=2, seed=0).fit(matrix)
+    model_b = ImplicitALS(rank=8, max_iter=4, seed=3).fit(matrix)
+    return tables, matrix, model_a, model_b
+
+
+def _write_model(name: str, model: ALSModel):
+    path = artifact_path(name)
+    save_pickle(path, model.to_arrays())
+    write_manifest(path)
+    return path
+
+
+def _service(artifacts, **kw):
+    tables, matrix, model_a, _ = artifacts
+    kw.setdefault("batch_window_ms", 0.0)
+    return RecommendationService(model_a, matrix, repo_info=tables.repo_info, **kw)
+
+
+def test_capacity_gate_refuses_without_quarantine(artifacts, monkeypatch):
+    tables, matrix, model_a, model_b = artifacts
+    with _service(artifacts) as svc:
+        mgr = HotSwapManager(svc, probe_users=4, probe_k=K)
+        path = _write_model("pressure-alsModel.pkl", model_b)
+        monkeypatch.setenv("ALBEDO_DEVICE_MEM_BYTES", "2k")
+        before_corruptions = events.artifact_corruptions.total()
+        report = mgr.request_reload(path)
+        assert report["outcome"] == "rejected"
+        assert report["gate"] == "capacity"
+        assert "alongside the incumbent" in report["detail"]
+        # NOT quarantined: the bytes are fine, this process is full.
+        assert path.exists()
+        assert report["quarantined_to"] is None
+        assert events.artifact_corruptions.total() == before_corruptions
+        assert svc.metrics.reload_rejected.value(gate="capacity") == 1
+        # Incumbent untouched.
+        assert svc.generation.number == 1 and svc.generation.model is model_a
+
+
+def test_capacity_gate_admits_when_budget_allows(artifacts, monkeypatch):
+    tables, matrix, model_a, model_b = artifacts
+    with _service(artifacts) as svc:
+        mgr = HotSwapManager(svc, probe_users=4, probe_k=K)
+        path = _write_model("roomy-alsModel.pkl", model_b)
+        monkeypatch.setenv("ALBEDO_DEVICE_MEM_BYTES", "4g")
+        report = mgr.request_reload(path)
+        assert report["outcome"] == "promoted", report
+        gate = report["gates"]["capacity"]
+        assert gate["generations_resident"] == 2
+        assert 0 < gate["required_bytes"] <= gate["budget_bytes"]
+
+
+def test_capacity_prices_single_generation_on_cold_boot(artifacts, monkeypatch):
+    """No incumbent model -> only ONE generation is resident post-swap."""
+    tables, matrix, _, model_b = artifacts
+    with RecommendationService(None, matrix, repo_info=tables.repo_info,
+                               batch_window_ms=0.0) as svc:
+        mgr = HotSwapManager(svc, probe_users=4, probe_k=K)
+        path = _write_model("coldboot-alsModel.pkl", model_b)
+        monkeypatch.setenv("ALBEDO_DEVICE_MEM_BYTES", "4g")
+        report = mgr.request_reload(path)
+        assert report["outcome"] == "promoted", report
+        assert report["gates"]["capacity"]["generations_resident"] == 1
+
+
+def _get(handle, path):
+    host, port = handle.server_address[:2]
+    try:
+        with urllib.request.urlopen(f"http://{host}:{port}{path}", timeout=30) as r:
+            return r.status, json.loads(r.read() or b"{}")
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}")
+
+
+def _post(handle, path):
+    host, port = handle.server_address[:2]
+    req = urllib.request.Request(
+        f"http://{host}:{port}{path}", data=b"", method="POST"
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=60) as r:
+            return r.status, json.loads(r.read() or b"{}")
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}")
+
+
+@pytest.mark.chaos
+def test_hot_swap_under_memory_pressure_over_http(artifacts, monkeypatch):
+    """The satellite drill: a reload over HTTP whose candidate generation
+    exceeds the remaining budget — incumbent keeps serving byte-identical
+    answers, the rejection is counted in
+    ``albedo_reload_rejected_total{gate=capacity}``, the artifact is NOT
+    quarantined, and raising the budget admits the same bytes verbatim."""
+    tables, matrix, model_a, model_b = artifacts
+    with _service(artifacts) as svc:
+        HotSwapManager(svc, probe_users=4, probe_k=K)
+        with serve(svc, port=0) as handle:
+            uid = int(matrix.user_ids[1])
+            status, before = _get(handle, f"/recommend/{uid}?k={K}&exclude_seen=0")
+            assert status == 200 and before["generation"] == 1
+
+            path = _write_model("http-pressure-alsModel.pkl", model_b)
+            monkeypatch.setenv("ALBEDO_DEVICE_MEM_BYTES", "2k")
+            status, report = _post(handle, "/admin/reload?artifact=" + path.name)
+            assert status == 409
+            assert report["outcome"] == "rejected" and report["gate"] == "capacity"
+
+            # Incumbent kept serving, same generation, same answers.
+            status, after = _get(handle, f"/recommend/{uid}?k={K}&exclude_seen=0")
+            assert status == 200 and after["generation"] == 1
+            assert after["items"] == before["items"]
+
+            # Counted on /metrics; artifact NOT renamed away.
+            host, port = handle.server_address[:2]
+            with urllib.request.urlopen(f"http://{host}:{port}/metrics", timeout=30) as r:
+                text = r.read().decode()
+            assert 'albedo_reload_rejected_total{gate="capacity"} 1' in text
+            assert 'artifact="http-pressure-alsModel.pkl"' not in text
+            assert path.exists()
+
+            # Pressure relieved (bigger box, incumbent retired, ...): the
+            # SAME artifact promotes — nothing destroyed it.
+            monkeypatch.setenv("ALBEDO_DEVICE_MEM_BYTES", "4g")
+            status, report = _post(handle, "/admin/reload?artifact=" + path.name)
+            assert status == 200 and report["outcome"] == "promoted", report
+            status, swapped = _get(handle, f"/recommend/{uid}?k={K}&exclude_seen=0")
+            assert status == 200 and swapped["generation"] == 2
+            got_scores = [i["score"] for i in swapped["items"]]
+            assert np.isfinite(got_scores).all()

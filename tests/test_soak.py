@@ -1,0 +1,138 @@
+"""The chaos soak: schedule determinism and kind coverage, the invariant
+sweep, and the fast in-process ``soak-smoke`` — two full ingest -> train ->
+publish -> serve -> stream cycles under a seeded fault schedule with every
+in-process kind observed firing and every standing invariant green."""
+
+import argparse
+import json
+
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from albedo_tpu.chaos.soak import (  # noqa: E402
+    KIND_EVIDENCE,
+    REPORT_NAME,
+    build_schedule,
+    check_invariants,
+    run_soak,
+)
+from albedo_tpu.datasets import synthetic_tables  # noqa: E402
+from albedo_tpu.datasets.artifacts import get_settings  # noqa: E402
+
+
+def make_args():
+    return argparse.Namespace(
+        small=True, tables=None, now=1700000000.0, no_compilation_cache=True,
+        data_policy=None, solver="cholesky", cg_steps=3, checkpoint_every=0,
+        resume=False, keep_last=3, _rest=[],
+    )
+
+
+class TestSchedule:
+    def test_deterministic_for_a_seed(self):
+        a = build_schedule(5, seed=9, include_kill_term=True)
+        b = build_schedule(5, seed=9, include_kill_term=True)
+        assert a == b
+        c = build_schedule(5, seed=10, include_kill_term=True)
+        assert a != c
+
+    def test_every_kind_scheduled(self):
+        schedule = build_schedule(10, seed=1, include_kill_term=True)
+        kinds = {
+            k
+            for cycle in schedule
+            for specs in cycle.values()
+            for _, k, _ in specs
+        }
+        assert kinds >= set(KIND_EVIDENCE)
+
+    def test_kill_term_excluded_in_process(self):
+        schedule = build_schedule(4, seed=1, include_kill_term=False)
+        kinds = {
+            k
+            for cycle in schedule
+            for specs in cycle.values()
+            for _, k, _ in specs
+        }
+        assert "kill" not in kinds and "term" not in kinds
+
+    def test_canonical_sites_never_double_armed(self):
+        """Only the FIRST matching armed spec fires at a hit — the coverage
+        pass must displace same-site random draws, not stack onto them."""
+        for seed in range(6):
+            schedule = build_schedule(6, seed=seed, include_kill_term=True)
+            for cycle in schedule:
+                for specs in cycle.values():
+                    sites = [s for s, _, _ in specs]
+                    assert len(sites) == len(set(sites)), (seed, specs)
+
+    def test_kill_term_cycles_carry_only_the_preemption(self):
+        schedule = build_schedule(8, seed=3, include_kill_term=True)
+        for cycle in schedule:
+            kinds = [k for _, k, _ in cycle["pipeline"]]
+            if "kill" in kinds or "term" in kinds:
+                assert len(kinds) == 1
+
+    def test_too_few_cycles_rejected(self):
+        with pytest.raises(ValueError, match="at least 2"):
+            build_schedule(1, seed=0, include_kill_term=True)
+
+
+class TestInvariantSweep:
+    def test_clean_store_has_no_violations(self):
+        assert check_invariants(get_settings().artifact_dir) == []
+
+    def test_torn_publish_detected(self):
+        art_dir = get_settings().artifact_dir
+        art_dir.mkdir(parents=True, exist_ok=True)
+        bad = art_dir / "torn-alsModel.pkl"
+        bad.write_bytes(b"garbage")
+        (art_dir / "torn-alsModel.pkl.sha256").write_text(
+            json.dumps({"sha256": "0" * 64, "size": 7})
+        )
+        violations = check_invariants(art_dir)
+        assert any("torn publish" in v for v in violations)
+
+    def test_unparseable_journal_detected(self):
+        art_dir = get_settings().artifact_dir
+        art_dir.mkdir(parents=True, exist_ok=True)
+        (art_dir / "x-pipeline-journal.json").write_text('{"half": ')
+        violations = check_invariants(art_dir)
+        assert any("journal" in v for v in violations)
+
+    def test_quarantined_evidence_is_ignored(self):
+        art_dir = get_settings().artifact_dir
+        art_dir.mkdir(parents=True, exist_ok=True)
+        (art_dir / "old-alsModel.pkl.corrupt-1").write_bytes(b"evidence")
+        assert check_invariants(art_dir) == []
+
+
+@pytest.mark.chaos
+def test_soak_smoke(monkeypatch):
+    """The `soak-smoke` subset: 2 in-process cycles over tiny tables. Every
+    in-process fault kind must be OBSERVED firing, the capacity drill must
+    complete its over-budget fit via degrade with resident parity, and
+    every standing invariant must hold on every cycle."""
+    monkeypatch.setenv("ALBEDO_TODAY", "20260803")
+    tables = synthetic_tables(n_users=120, n_items=80, mean_stars=10, seed=11)
+    report = run_soak(
+        make_args(), cycles=2, seed=7, subprocess_legs=False,
+        ctx_kwargs={"tables": tables, "tag": "soaksmoke"},
+    )
+    assert report["violations"] == []
+    assert report["ok"] is True
+    assert report["capacity_drill"]["ok"] is True
+    assert report["capacity_drill"]["mode"] == "chunked"
+    assert set(report["kinds_observed"]) >= {
+        "error", "ioerror", "corrupt", "delay", "oom",
+    }
+    # Every leg of every cycle reported an exit code inside the contract.
+    for cycle in report["cycles"]:
+        for leg in cycle["legs"]:
+            assert leg["rc"] in (0, 1, 3, 4, 75), (cycle["cycle"], leg)
+        assert cycle["invariant_violations"] == []
+    # The report is a sealed artifact-store product.
+    report_path = get_settings().artifact_dir / REPORT_NAME
+    assert report_path.exists()
+    assert json.loads(report_path.read_text())["ok"] is True

@@ -182,3 +182,67 @@ def test_foldin_splits_oversized_batches(trained):
     assert solved.shape == (10, model.rank)
     assert engine.batches_run == 3  # 4 + 4 + 2
     assert engine.users_solved == 10
+
+
+# --- the capacity-budgeted ladder cap (PR 7) ----------------------------------
+
+
+def _random_rows(model, n=24, max_len=8, seed=5):
+    rng = np.random.default_rng(seed)
+    rows = []
+    for _ in range(n):
+        ln = int(rng.integers(1, max_len + 1))
+        idx = rng.choice(
+            model.item_factors.shape[0], size=ln, replace=False
+        ).astype(np.int32)
+        rows.append((idx, np.ones(ln, np.float32)))
+    return rows
+
+
+def test_ladder_cap_splits_batches_with_identical_results(trained, monkeypatch):
+    _, model = trained
+    rows = _random_rows(model)
+    reference = FoldInEngine(model, max_batch=32).fold_in(rows)
+
+    monkeypatch.setenv("ALBEDO_DEVICE_MEM_BYTES", "12k")
+    capped = FoldInEngine(model, max_batch=32)
+    assert capped.rung_cap_entries < 32 * 8
+    solved = capped.fold_in(rows)
+    assert capped.batches_run > 1
+    assert capped.rung_capped >= 1
+    np.testing.assert_allclose(solved, reference, atol=1e-5)
+
+
+def test_single_long_row_always_dispatches(trained, monkeypatch):
+    """The cap cannot shrink a row's length — a lone oversized row must
+    still dispatch (if it genuinely OOMs, the solve itself says so)."""
+    _, model = trained
+    monkeypatch.setenv("ALBEDO_DEVICE_MEM_BYTES", "6k")
+    engine = FoldInEngine(model, max_batch=16)
+    idx = np.arange(32, dtype=np.int32)
+    solved = engine.fold_in([(idx, np.ones(32, np.float32))])
+    assert solved.shape == (1, model.rank)
+    assert np.isfinite(solved).all()
+
+
+def test_forced_oom_at_admission_degrades_and_splits(trained):
+    _, model = trained
+    rows = _random_rows(model, seed=6)
+    reference = FoldInEngine(model, max_batch=32).fold_in(rows)
+    engine = FoldInEngine(model, max_batch=32)
+    faults.arm("capacity.admit", kind="oom", at=1)
+    try:
+        solved = engine.fold_in(rows)
+    finally:
+        faults.disarm("capacity.admit")
+    assert engine.batches_run > 1  # the degrade verdict provably split
+    np.testing.assert_allclose(solved, reference, atol=1e-5)
+
+
+def test_warm_respects_the_budgeted_rung(trained, monkeypatch):
+    _, model = trained
+    monkeypatch.setenv("ALBEDO_DEVICE_MEM_BYTES", "12k")
+    engine = FoldInEngine(model, max_batch=32)
+    engine.warm(lengths=(4, 8))
+    for bucket, length in engine._executables:
+        assert bucket * length <= engine.rung_cap(length)
