@@ -84,5 +84,12 @@ soak-smoke:
 capacity-bench:
 	$(PY) bench.py capacity
 
+# ALX-scale weak scaling: the fully sharded streamed fit at 1 -> 2 -> 4 -> 8
+# chips with fixed work per chip (out-of-core synthetic star matrices),
+# per-sweep wall-clock + achieved GB/s per chip + the largest-fittable-matrix
+# estimate -> MULTICHIP_r06.json (see README "Scale runbook").
+scale-bench:
+	JAX_PLATFORMS=cpu $(PY) bench.py scale
+
 dryrun:
 	$(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('ok')"

@@ -103,7 +103,11 @@ SERVE_FAULTS = (
     ("reload.validate", "error"),
     ("capacity.admit", "oom"),
 )
-MESH_FAULTS = (("mesh.devices", "error"),)
+MESH_FAULTS = (
+    ("mesh.devices", "error"),
+    ("als.shard.gather", "delay"),
+    ("als.shard.stream", "error"),
+)
 
 # Canonical per-kind evidence placements: where each kind is armed so its
 # firing is OBSERVABLE regardless of what else the cycle draws. The mesh and
@@ -168,6 +172,14 @@ def build_schedule(
         schedule[cycle][leg] = [
             (s, kd, a) for s, kd, a in schedule[cycle][leg] if s != site
         ] + [(site, k, at)]
+    # Sharded-fit coverage: the mesh leg runs a tiny row-sharded ALS fit
+    # every cycle; pin one cycle to arm its `als.shard.gather` site (delay =
+    # observable and benign) so every soak — the 2-cycle smoke included —
+    # drills the sharded path's chaos surface, not just mesh boot.
+    schedule[cycles - 1]["mesh"] = [
+        (s, k, a) for s, k, a in schedule[cycles - 1]["mesh"]
+        if s != "als.shard.gather"
+    ] + [("als.shard.gather", "delay", 1)]
     # A kill/term pipeline leg must not ALSO carry raising faults that could
     # fail the stage before the preemption fires.
     for c in range(cycles):
@@ -391,7 +403,11 @@ def _stream_in_process(ctx_factory, args, specs, cycle_seed: int) -> dict:
 def _mesh_leg(specs) -> dict:
     """The boot leg: a mesh request that may exceed the visible devices (or
     lose half of them to a mesh.devices fault) must remesh down the ladder,
-    never assert-crash."""
+    never assert-crash. The leg then drives a tiny ROW-SHARDED fit on the
+    booted mesh (``parallel.als.ShardedALSFit`` streamed), so the
+    ``als.shard.gather``/``als.shard.stream`` chaos surface is exercised
+    every cycle: an armed raising kind must surface as a failed fit (the
+    pipeline's fail-fast contract), never a hang or a wrong result."""
     import jax
 
     from albedo_tpu.parallel.mesh import make_mesh
@@ -399,13 +415,55 @@ def _mesh_leg(specs) -> dict:
     before = events.mesh_degraded.total()
     with _InProcessArm(specs) as armed:
         mesh = make_mesh(8)  # more than a 1-device CPU soak box has
+        shard_rec = _sharded_fit_drill(mesh, specs)
     n = int(np.prod(list(mesh.shape.values())))
+    rc = 0 if (n >= 1 and shard_rec.pop("ok")) else 1
     return {
-        "job": "mesh_boot", "rc": 0 if n >= 1 else 1,
+        "job": "mesh_boot", "rc": rc,
         "devices": n, "visible": len(jax.devices()),
         "degraded": events.mesh_degraded.total() - before,
+        "sharded_fit": shard_rec,
         "fired": armed.fired,
         "faults": [f"{s}:{k}@{a}" for s, k, a in specs],
+    }
+
+
+def _sharded_fit_drill(mesh, specs) -> dict:
+    """One streamed sharded fit on ``mesh``. A raising kind armed on an
+    ``als.shard.*`` site makes the fit fail CLEANLY (recorded, ok=True);
+    any other exception, non-finite factors, or an injected fault that
+    neither fired nor failed is a violation."""
+    from albedo_tpu.datasets.synthetic import synthetic_stars
+    from albedo_tpu.models.als import ImplicitALS
+
+    matrix = synthetic_stars(n_users=48, n_items=32, mean_stars=5, seed=21)
+    est = ImplicitALS(
+        rank=4, max_iter=1, batch_size=16, seed=0, mesh=mesh,
+        sharded="streamed",
+    )
+    shard_specs = {s for s, _, _ in specs if s.startswith("als.shard.")}
+    raising = {
+        s for s, k, _ in specs
+        if s.startswith("als.shard.") and k in ("error", "ioerror", "oom")
+    }
+    try:
+        model = est.fit(matrix)
+    except Exception as e:  # noqa: BLE001
+        injected = any(faults.FAULTS.fired(s) for s in shard_specs)
+        return {
+            "ok": bool(injected), "outcome": "failed",
+            "error": repr(e)[-200:], "injected": injected,
+        }
+    finite = bool(np.isfinite(model.user_factors).all())
+    # An armed RAISING shard fault that neither fired nor failed the fit is
+    # zero coverage wearing a green checkmark — flag it.
+    unfired = sorted(s for s in raising if not faults.FAULTS.fired(s))
+    return {
+        "ok": finite and not unfired,
+        "outcome": "completed",
+        "mode": est.last_fit_report.get("mode"),
+        "streamed_buckets": est.last_fit_report.get("streamed_buckets"),
+        "unfired_faults": unfired,
     }
 
 
@@ -547,6 +605,11 @@ def run_soak(
         mesh_rec = _mesh_leg(plan["mesh"])
         cycle["legs"].append(mesh_rec)
         observe_in_process(mesh_rec, plan["mesh"])
+        if mesh_rec["rc"] != 0:
+            report["violations"].append(
+                f"cycle {c + 1} mesh leg: "
+                f"{mesh_rec.get('sharded_fit', mesh_rec)}"
+            )
 
         pipeline_args = [
             "--small", "--checkpoint-every", "2",

@@ -250,6 +250,16 @@ class ImplicitALS:
     # decides (a `degrade` falls back to the chunked host-streamed path),
     # True/False force the chunked/resident path (bench A/B, tests).
     chunked: bool | None = None
+    # Mesh-path admission (requires self.mesh): None = the admission LADDER
+    # decides — replicated-resident -> sharded tables -> sharded + streamed
+    # buckets; False forces the replicated GSPMD path; "resident"/True force
+    # row-sharded tables with resident buckets; "streamed" additionally
+    # streams interaction buckets from the host per half-sweep (the star
+    # matrix is never device-resident whole).
+    sharded: Any | None = None
+    # Source-factor assembly for the sharded path: "allgather" (full table
+    # transient per bucket) or "ring" (ppermute'd 1/n shards, cholesky only).
+    shard_mode: str = "allgather"
 
     def _layout_kwargs(self) -> dict:
         return dict(
@@ -487,6 +497,35 @@ class ImplicitALS:
             raise capacity_mod.CapacityExceeded(verdict)
         return verdict
 
+    def admission_mesh(self, matrix: StarMatrix):
+        """Admission ladder for the mesh path (closes the PR 7 'mesh path
+        exempt' blind spot): replicated-resident GSPMD fit -> row-sharded
+        tables with resident sharded buckets -> sharded + host-streamed
+        buckets. Each rung is priced PER DEVICE; the first rung that fits
+        the budget wins (``verdict.chosen``). When even the streamed rung
+        busts the budget, raises :class:`~albedo_tpu.utils.capacity.
+        CapacityExceeded` — that matrix needs more chips, not more spilling.
+        """
+        from albedo_tpu.parallel.mesh import DATA_AXIS
+
+        n_dev = int(self.mesh.shape[DATA_AXIS])
+        shapes_u, shapes_i = self._plan_shapes(matrix)
+        args = (shapes_u, shapes_i, matrix.n_users, matrix.n_items, self.rank)
+        shard_kw = dict(
+            gather_dtype=self.gather_dtype, mode=self.shard_mode,
+            solver=self.solver,
+        )
+        verdict = capacity_mod.admit_ladder([
+            capacity_mod.plan_fit(
+                *args, gather_dtype=self.gather_dtype, n_devices=n_dev
+            ),
+            capacity_mod.plan_fit_sharded(*args, n_dev, streamed=False, **shard_kw),
+            capacity_mod.plan_fit_sharded(*args, n_dev, streamed=True, **shard_kw),
+        ])
+        if verdict.verdict == "refuse":
+            raise capacity_mod.CapacityExceeded(verdict)
+        return verdict
+
     # -------------------------------------------------------------- training
 
     def fit(self, matrix: StarMatrix, callback: Any | None = None) -> ALSModel:
@@ -523,6 +562,25 @@ class ImplicitALS:
                 use_chunked = admission.verdict == "degrade"
         if use_chunked:
             return self._fit_chunked(matrix, callback, admission, t0)
+        if self.mesh is not None:
+            # The mesh path is no longer capacity-exempt: the admission
+            # LADDER picks replicated-resident -> sharded -> sharded +
+            # streamed (or raises), unless self.sharded forces a mode.
+            sharded = self.sharded
+            if sharded is None:
+                sharded = False
+                if not cache_warm and capacity_mod.enabled():
+                    admission = self.admission_mesh(matrix)
+                    sharded = {
+                        "als_fit": False,
+                        "als_fit_sharded": "resident",
+                        "als_fit_sharded_streamed": "streamed",
+                    }[admission.chosen]
+            if sharded:
+                return self._fit_sharded(
+                    matrix, callback, admission, t0,
+                    streamed=(sharded == "streamed"),
+                )
         ug, ig, u_land, i_land = self.device_groups(matrix)
         prep_split = dict(getattr(self, "last_prep_timings", {}))
         t1 = time.perf_counter()
@@ -754,5 +812,77 @@ class ImplicitALS:
             "mode": "chunked",
             "capacity": None if admission is None else admission.to_dict(),
             "chunked_shapes": len(executables),
+        }
+        return ALSModel(user_factors=user_f, item_factors=item_f, rank=self.rank)
+
+    def _fit_sharded(
+        self,
+        matrix: StarMatrix,
+        callback: Any | None,
+        admission,
+        t0: float,
+        streamed: bool,
+    ) -> ALSModel:
+        """The ALX-layout fit: BOTH factor tables row-sharded over the
+        mesh's data axis, per-device bucket blocks solved against
+        all-gathered (or ring-passed) source shards inside shard_map, and —
+        when ``streamed`` — interaction buckets uploaded per half-sweep so
+        the star matrix is never device-resident whole. Same kernels as
+        every other path (``ops.als.bucket_solve_body``/``bucket_cg_body``
+        via ``parallel.als.ShardedALSFit``), per-shape executables through
+        the persistent AOT layer, and the watchdog health reduction as the
+        completion barrier — parity with the single-device resident fit is
+        test-pinned at atol 1e-5.
+        """
+        from albedo_tpu.parallel.als import sharded_fit_engine
+        from albedo_tpu.parallel.mesh import DATA_AXIS
+
+        engine = sharded_fit_engine(
+            self.mesh, DATA_AXIS, self.solver, self.cg_steps,
+            self.gather_dtype, self.shard_mode,
+        )
+        user_buckets, item_buckets = self._host_buckets(matrix)
+        t1 = time.perf_counter()
+
+        if self.init_factors is not None:
+            user_f = np.asarray(self.init_factors[0], np.float32)
+            item_f = np.asarray(self.init_factors[1], np.float32)
+        else:
+            # Eager seeded init: same traced PRNG ops + key as the fused
+            # init, so the values are identical (see als_init_fit_fused).
+            key = jax.random.PRNGKey(self.seed)
+            ukey, ikey = jax.random.split(key)
+            scale = 1.0 / np.sqrt(self.rank)
+            user_f = jax.random.normal(ukey, (matrix.n_users, self.rank), jnp.float32) * scale
+            item_f = jax.random.normal(ikey, (matrix.n_items, self.rank), jnp.float32) * scale
+
+        user_f, item_f, stats = engine.fit(
+            user_f, item_f, user_buckets, item_buckets,
+            self.reg_param, self.alpha, self.max_iter,
+            streamed=streamed, callback=callback,
+        )
+
+        from albedo_tpu.utils.watchdog import factor_health, health_dict
+
+        # The d2h health read doubles as the completion barrier, exactly as
+        # on the resident path.
+        health = health_dict(factor_health(user_f, item_f))
+        t2 = time.perf_counter()
+        compile_s = stats["compile_s"]
+        self.last_fit_report = {
+            "prep_s": round(t1 - t0, 4),
+            "bucket_s": round(t1 - t0, 4),
+            "upload_s": stats["upload_s"],
+            "compile_s": round(compile_s, 4),
+            "compile_source": "+".join(sorted(stats["compile_sources"])) or None,
+            "device_s": round(t2 - t1 - compile_s, 4),
+            "prep_cached": False,
+            "health": health,
+            "mode": "sharded_streamed" if streamed else "sharded",
+            "shard_mode": self.shard_mode,
+            "n_shards": engine.n_shards,
+            "capacity": None if admission is None else admission.to_dict(),
+            "streamed_buckets": stats["streamed_buckets"],
+            "sharded_shapes": stats["n_shapes"],
         }
         return ALSModel(user_factors=user_f, item_factors=item_f, rank=self.rank)

@@ -261,13 +261,29 @@ class Word2Vec:
             labels = jnp.zeros_like(logits).at[:, 0].set(1.0)
             return optax.sigmoid_binary_cross_entropy(logits, labels).sum(axis=1).mean()
 
+        # W2V only ever shards the pair batch over "data". A 2-D (data, item)
+        # mesh must be FLATTENED to a 1-D data-only mesh here: with an unused
+        # `item` axis in scope, GSPMD is free to re-partition the table-grad
+        # reductions across it, which injects ~1e-6/step f32 reduction-order
+        # noise that Adam amplifies chaotically into O(1) embedding divergence
+        # within an epoch (root-caused from the dryrun_multichip sharded-vs-
+        # single assert; the flat mesh is bit-stable at ~3e-7 vs single
+        # device). Flattening also puts every device on the data axis — more
+        # parallel, not less.
+        mesh = self.mesh
+        if mesh is not None and any(
+            n > 1 for ax, n in mesh.shape.items() if ax != DATA_AXIS
+        ):
+            from jax.sharding import Mesh
+
+            mesh = Mesh(np.asarray(mesh.devices).reshape(-1), (DATA_AXIS,))
         # Shard the minibatch dim only when it divides evenly; otherwise leave
         # layout to XLA (still correct, just less parallel) rather than change
         # bs and silently diverge from the single-device math.
-        if self.mesh is not None and bs % int(self.mesh.shape[DATA_AXIS]) == 0:
+        if mesh is not None and bs % int(mesh.shape[DATA_AXIS]) == 0:
             from jax.sharding import NamedSharding, PartitionSpec
 
-            batch_sharding = NamedSharding(self.mesh, PartitionSpec(None, DATA_AXIS))
+            batch_sharding = NamedSharding(mesh, PartitionSpec(None, DATA_AXIS))
         else:
             batch_sharding = None
 
@@ -301,11 +317,11 @@ class Word2Vec:
             )
             return params, opt_state, key, losses.mean()
 
-        if self.mesh is not None:
+        if mesh is not None:
             # Pair pool replicated (it is small relative to HBM and keeps the
             # global permutation identical to the single-device run); each
             # step's minibatch is then sharded by the constraint above.
-            repl = replicated(self.mesh)
+            repl = replicated(mesh)
             centers_d = jax.device_put(centers, repl)
             contexts_d = jax.device_put(contexts, repl)
             params = jax.device_put(params, repl)

@@ -84,19 +84,34 @@ def bucket_solve_body(
     """The normal-equation solve for a padded bucket: gather → fused Gramian
     correction → batched Cholesky. Shared by the single-device and shard_map'd
     paths (``parallel.als``), so a parity fix lands in both."""
-    k = source.shape[1]
     gathered = _gather(source, idx, gather_dtype)  # (B, L, k)
     c1 = alpha * val                            # (B, L); 0 on padding
     w = jnp.where(mask, 1.0 + c1, 0.0)          # b-vector weights
 
-    # A_b = YtY + sum_l c1 * y y^T + reg * n_b * I
+    corr, b_vec = bucket_partial_terms(gathered, c1, w)
+    n_b = mask.sum(axis=1).astype(jnp.float32)
+    return solve_corrected(yty, corr, b_vec, n_b, reg)
+
+
+def bucket_partial_terms(
+    gathered: jax.Array,  # (B, L, k) gathered source rows (zeros where absent)
+    c1: jax.Array,        # (B, L) alpha * val, zeroed where the entry is absent
+    w: jax.Array,         # (B, L) b-vector weights, zeroed where absent
+) -> tuple[jax.Array, jax.Array]:
+    """The Gramian correction and b-vector for one (partial) gathered block.
+
+    The bucket solve's data-dependent terms are SUMS over a row's entries, so
+    a ring-passed sharded sweep (``parallel.als`` with ``mode="ring"``) can
+    accumulate them phase by phase — each phase zeroing the entries whose
+    source rows live on a shard not yet seen — and the total equals the
+    full-gather terms. Factored out so the ring path's math IS
+    ``bucket_solve_body``'s math, not a reimplementation.
+    """
+    # A_b correction = sum_l c1 * y y^T
     corr = jnp.einsum(
         "blk,bl,blm->bkm", gathered, c1.astype(gathered.dtype), gathered,
         preferred_element_type=jnp.float32,
     )
-    n_b = mask.sum(axis=1).astype(jnp.float32)
-    eye = jnp.eye(k, dtype=jnp.float32)
-    a_mat = yty[None] + corr + (reg * n_b)[:, None, None] * eye
     # b-vector weights stay float32 even under bf16 gathers: w = 1 + alpha*r
     # spends ~8 significant bits on the integer part alone, so a bf16 cast
     # adds ~0.4% relative error per entry (ADVICE r5 #3). The MXU consumes
@@ -105,7 +120,21 @@ def bucket_solve_body(
     b_vec = jnp.einsum(
         "blk,bl->bk", gathered, w, preferred_element_type=jnp.float32
     )
+    return corr, b_vec
 
+
+def solve_corrected(
+    yty: jax.Array,    # (k, k)
+    corr: jax.Array,   # (B, k, k) accumulated Gramian correction
+    b_vec: jax.Array,  # (B, k)
+    n_b: jax.Array,    # (B,) float32 per-row nonzero counts
+    reg: jax.Array,    # () float32
+) -> jax.Array:
+    """Batched Cholesky solve of ``(YtY + corr + reg n_b I) x = b`` — the
+    shared tail of the full-gather and ring-accumulated bucket solves."""
+    k = yty.shape[0]
+    eye = jnp.eye(k, dtype=jnp.float32)
+    a_mat = yty[None] + corr + (reg * n_b)[:, None, None] * eye
     chol = jnp.linalg.cholesky(a_mat)
     return jax.scipy.linalg.cho_solve((chol, True), b_vec[..., None])[..., 0]
 

@@ -20,9 +20,12 @@ from albedo_tpu.parallel.mesh import (  # noqa: F401
     row_sharded,
 )
 from albedo_tpu.parallel.als import (  # noqa: F401
+    ShardedALSFit,
     ShardedALSSweep,
     make_sharded_solver,
+    make_sharded_update,
     pad_bucket,
+    sharded_fit_engine,
     sharded_gramian,
 )
 from albedo_tpu.parallel.topk import (  # noqa: F401

@@ -20,13 +20,23 @@ replacement, two composable pieces:
 
 Factor tables are replicated by default: at albedo scale (≤ millions of rows ×
 rank 50, float32) a full table is ≤ a few hundred MB — far below HBM — and
-replication makes the per-bucket arbitrary-index gather local. The sharded
-storage path exists for larger-than-HBM factor tables.
+replication makes the per-bucket arbitrary-index gather local.
+
+3. **The fully sharded fit** (`ShardedALSFit`, ALX arXiv:2112.02194) for
+   larger-than-HBM factor tables: BOTH tables row-sharded over ``data``,
+   per-device bucket blocks solved against all-gathered or ring-passed
+   source shards inside shard_map, solved rows landed shard-locally from a
+   small all-gathered block, and (optionally) interaction buckets STREAMED
+   from the host per half-sweep so the star matrix is never device-resident
+   whole. ``models.als.ImplicitALS`` dispatches here when the capacity
+   admission ladder says the replicated layout no longer fits
+   (ARCHITECTURE.md "Sharded ALS").
 """
 
 from __future__ import annotations
 
 import functools
+import time
 
 import jax
 import jax.numpy as jnp
@@ -35,11 +45,25 @@ try:  # jax >= 0.6 exports shard_map at top level
     from jax import shard_map
 except ImportError:  # 0.4.x spelling
     from jax.experimental.shard_map import shard_map
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from albedo_tpu.datasets.ragged import Bucket, device_bucket
-from albedo_tpu.ops.als import bucket_solve_body
+from albedo_tpu.ops.als import (
+    bucket_cg_body,
+    bucket_partial_terms,
+    bucket_solve_body,
+    solve_corrected,
+)
 from albedo_tpu.parallel.mesh import DATA_AXIS, pad_rows_to, row_sharded
+from albedo_tpu.utils import faults
+
+# Chaos hooks for the fully sharded fit: `als.shard.gather` fires once per
+# half-sweep ahead of the source-shard assembly (the all-gather / ring pass),
+# `als.shard.stream` fires before every streamed bucket upload — so drills
+# can fail or kill a sharded fit mid-collective or mid-stream, exactly like
+# `als.chunked` does for the single-device degraded path.
+SHARD_GATHER_FAULT = faults.site("als.shard.gather")
+SHARD_STREAM_FAULT = faults.site("als.shard.stream")
 
 
 def pad_bucket(b: Bucket, multiple: int) -> Bucket:
@@ -109,6 +133,344 @@ def _local_bucket_solve(source, yty, row_ids, idx, val, mask, reg, alpha):
     path via ``ops.als.bucket_solve_body``."""
     del row_ids  # only needed for the scatter, outside the shard
     return bucket_solve_body(source, yty, idx, val, mask, reg, alpha)
+
+
+# --- fully sharded fit (ALX layout) -------------------------------------------
+#
+# Both factor tables stored ROW-SHARDED over the mesh's data axis (1/n of each
+# table resident per device), bucket batch dims sharded the same way, and the
+# fixed side's factors assembled per bucket inside shard_map:
+#
+# ``mode="allgather"``  one tiled all-gather materializes the full (padded)
+#                       source table transiently per bucket — minimal FLOPs,
+#                       transient HBM = one full table.
+# ``mode="ring"``       the source shard rotates around the ring (ppermute);
+#                       each of the n phases accumulates the Gramian
+#                       correction and b-vector for the entries whose rows
+#                       live on the visiting shard (``ops.als.
+#                       bucket_partial_terms``) — n x the gather/einsum work,
+#                       but NO array larger than a 1/n table shard ever
+#                       materializes. Cholesky only: the CG matvec would need
+#                       the gathered rows at every step.
+#
+# Solved rows land by all-gathering the (small) solved block + row ids and
+# letting every device scatter the rows it owns into its target shard —
+# row-sharded in, row-sharded out, no host round trip.
+
+
+def _assembled_solve(
+    source_l, yty, target_l, row_ids_l, idx_l, val_l, mask_l, reg, alpha,
+    *, axis, solver, cg_steps, gather_dtype,
+):
+    """Per-device bucket solve against the all-gathered source table."""
+    source = jax.lax.all_gather(source_l, axis, axis=0, tiled=True)
+    if solver == "cg":
+        # Warm starts read the PRE-SWEEP target rows, which live on whatever
+        # shard owns them — assemble the target too (priced by the cost
+        # model as the CG mode's extra transient).
+        target = jax.lax.all_gather(target_l, axis, axis=0, tiled=True)
+        x0 = target[jnp.where(row_ids_l < 0, 0, row_ids_l)]
+        return bucket_cg_body(
+            source, yty, idx_l, val_l, mask_l, x0, reg, alpha, cg_steps,
+            gather_dtype=gather_dtype,
+        )
+    return bucket_solve_body(
+        source, yty, idx_l, val_l, mask_l, reg, alpha, gather_dtype=gather_dtype
+    )
+
+
+def _ring_solve(
+    source_l, yty, idx_l, val_l, mask_l, reg, alpha,
+    *, axis, n_shards, gather_dtype,
+):
+    """Per-device bucket solve with the source shard ring-passed: phase p
+    holds the shard born on device ``(self - p) mod n`` and accumulates the
+    normal-equation terms for entries whose global index falls in that
+    shard's row range; after n phases every entry has been seen exactly
+    once, so the accumulated terms equal the full-gather terms."""
+    rows_per = source_l.shape[0]
+    k = source_l.shape[1]
+    shard = jax.lax.axis_index(axis)
+    src0 = (
+        source_l if gather_dtype is None
+        else source_l.astype(jnp.dtype(gather_dtype))
+    )
+    c1_full = alpha * val_l                      # (B_l, L); 0 on padding
+    perm = [(i, (i + 1) % n_shards) for i in range(n_shards)]
+    b_l = idx_l.shape[0]
+    corr0 = jnp.zeros((b_l, k, k), jnp.float32)
+    bvec0 = jnp.zeros((b_l, k), jnp.float32)
+
+    def phase(p, carry):
+        src, corr, b_vec = carry
+        owner = jax.lax.rem(shard - p + n_shards, n_shards)
+        lo = owner * rows_per
+        rel = idx_l - lo
+        valid = mask_l & (rel >= 0) & (rel < rows_per)
+        g = jnp.where(
+            valid[..., None],
+            src[jnp.clip(rel, 0, rows_per - 1)],
+            jnp.zeros((), src.dtype),
+        )
+        c1 = jnp.where(valid, c1_full, 0.0)
+        w = jnp.where(valid, 1.0 + c1_full, 0.0)
+        dc, db = bucket_partial_terms(g, c1, w)
+        src = jax.lax.ppermute(src, axis, perm)
+        return src, corr + dc, b_vec + db
+
+    _, corr, b_vec = jax.lax.fori_loop(
+        0, n_shards, phase, (src0, corr0, bvec0)
+    )
+    n_b = mask_l.sum(axis=1).astype(jnp.float32)
+    return solve_corrected(yty, corr, b_vec, n_b, reg)
+
+
+def _sharded_update_body(
+    source_l, yty, target_l, row_ids_l, idx_l, val_l, mask_l, reg, alpha,
+    *, axis, n_shards, mode, solver, cg_steps, gather_dtype,
+):
+    if mode == "ring":
+        solved_l = _ring_solve(
+            source_l, yty, idx_l, val_l, mask_l, reg, alpha,
+            axis=axis, n_shards=n_shards, gather_dtype=gather_dtype,
+        )
+    else:
+        solved_l = _assembled_solve(
+            source_l, yty, target_l, row_ids_l, idx_l, val_l, mask_l, reg,
+            alpha, axis=axis, solver=solver, cg_steps=cg_steps,
+            gather_dtype=gather_dtype,
+        )
+    # Land: the solved block is small (B x k), so all-gather it with its row
+    # ids and let each device keep the rows its target shard owns. Padding
+    # slots (row_ids == -1) and foreign rows scatter out of range and drop.
+    rows_g = jax.lax.all_gather(row_ids_l, axis, axis=0, tiled=True)
+    solved_g = jax.lax.all_gather(solved_l, axis, axis=0, tiled=True)
+    shard = jax.lax.axis_index(axis)
+    rows_per = target_l.shape[0]
+    local = rows_g - shard * rows_per
+    local = jnp.where(
+        (rows_g >= 0) & (local >= 0) & (local < rows_per), local, rows_per
+    )
+    return target_l.at[local].set(solved_g, mode="drop")
+
+
+def make_sharded_update(mesh: Mesh, axis: str = DATA_AXIS, mode: str = "allgather"):
+    """Jitted sharded bucket update: row-sharded source/target tables in,
+    row-sharded target out. Bucket batch dims and both tables' row counts
+    must be device-count multiples (``pad_bucket`` / ``pad_rows_to``)."""
+    n_shards = mesh.shape[axis]
+
+    def update(source, yty, target, row_ids, idx, val, mask, reg, alpha,
+               solver="cholesky", cg_steps=3, gather_dtype=None):
+        body = functools.partial(
+            _sharded_update_body, axis=axis, n_shards=n_shards, mode=mode,
+            solver=solver, cg_steps=cg_steps, gather_dtype=gather_dtype,
+        )
+        f = shard_map(
+            body, mesh=mesh,
+            in_specs=(
+                P(axis, None), P(), P(axis, None), P(axis),
+                P(axis, None), P(axis, None), P(axis, None), P(), P(),
+            ),
+            out_specs=P(axis, None),
+        )
+        return f(source, yty, target, row_ids, idx, val, mask, reg, alpha)
+
+    return jax.jit(
+        update, donate_argnums=(2,),
+        static_argnames=("solver", "cg_steps", "gather_dtype"),
+    )
+
+
+@functools.lru_cache(maxsize=8)
+def sharded_fit_engine(
+    mesh: Mesh,
+    axis: str = DATA_AXIS,
+    solver: str = "cholesky",
+    cg_steps: int = 3,
+    gather_dtype: str | None = None,
+    mode: str = "allgather",
+) -> "ShardedALSFit":
+    """Memoized engine factory: ``Mesh`` is hashable and value-compared, so
+    repeated fits on the same layout reuse the engine's jitted update /
+    gramian closures and its per-shape executable handles instead of
+    retracing per fit."""
+    return ShardedALSFit(
+        mesh, axis=axis, solver=solver, cg_steps=cg_steps,
+        gather_dtype=gather_dtype, mode=mode,
+    )
+
+
+class ShardedALSFit:
+    """The fully sharded ALS fit: both tables row-sharded, buckets resident
+    (uploaded once, batch-sharded) or STREAMED from the host per half-sweep
+    so the star matrix is never device-resident whole.
+
+    Per-bucket-shape executables are acquired through the persistent AOT
+    layer (``utils.aot``) — sharded fits run in the same kill-resume chaos
+    as every other fit, so their cross-process executable reuse must stay
+    fingerprint-verified; ``models.als.ImplicitALS`` drives this engine when
+    the capacity admission ladder picks a sharded rung.
+    """
+
+    def __init__(
+        self,
+        mesh: Mesh,
+        axis: str = DATA_AXIS,
+        solver: str = "cholesky",
+        cg_steps: int = 3,
+        gather_dtype: str | None = None,
+        mode: str = "allgather",
+    ):
+        if solver not in ("cholesky", "cg"):
+            raise ValueError(f"unknown solver {solver!r}")
+        if mode not in ("allgather", "ring"):
+            raise ValueError(f"unknown shard mode {mode!r}")
+        if mode == "ring" and solver == "cg":
+            raise ValueError(
+                "ring mode supports the cholesky solver only: the CG matvec "
+                "re-reads the gathered rows every step, which would re-run "
+                "the whole ring per step — use mode='allgather' with cg"
+            )
+        self.mesh = mesh
+        self.axis = axis
+        self.solver = solver
+        self.cg_steps = int(cg_steps)
+        self.gather_dtype = gather_dtype
+        self.mode = mode
+        self.n_shards = int(mesh.shape[axis])
+        self._update = make_sharded_update(mesh, axis, mode)
+        self._gramian = sharded_gramian(mesh, axis)
+        self._rows1d = row_sharded(mesh, axis)
+        self._rows2d = NamedSharding(mesh, P(axis, None))
+        self._executables: dict[tuple, object] = {}
+
+    # ------------------------------------------------------------- layout
+    def shard_table(self, factors) -> jax.Array:
+        """Pad rows to a shard-count multiple (pad rows are zeros — no
+        bucket references them) and lay the table out row-sharded."""
+        f = np.asarray(factors, dtype=np.float32)
+        f = pad_rows_to(f, self.n_shards)
+        return jax.device_put(f, self._rows2d)
+
+    def put_bucket(self, b: Bucket) -> Bucket:
+        """Pad a host bucket's batch dim to the shard count and upload it
+        batch-sharded over the mesh."""
+        b = pad_bucket(b, self.n_shards)
+        return Bucket(
+            row_ids=jax.device_put(np.ascontiguousarray(b.row_ids), self._rows1d),
+            idx=jax.device_put(b.idx, self._rows2d),
+            val=jax.device_put(b.val, self._rows2d),
+            mask=jax.device_put(b.mask, self._rows2d),
+        )
+
+    # ------------------------------------------------------------ running
+    def _statics(self) -> dict:
+        return dict(
+            solver=self.solver, cg_steps=self.cg_steps,
+            gather_dtype=self.gather_dtype,
+        )
+
+    def _run_bucket(self, source, yty, target, b: Bucket, reg, alpha, stats: dict):
+        from albedo_tpu.utils.aot import persistent_aot_executable
+
+        args = (source, yty, target, b.row_ids, b.idx, b.val, b.mask, reg, alpha)
+        key = (source.shape[0], target.shape[0], tuple(b.idx.shape))
+        compiled = self._executables.get(key)
+        if compiled is None:
+            dev = jax.devices()[0]
+            compiled, c_s, tag = persistent_aot_executable(
+                self._update, args, None, self._statics(),
+                key_parts=(
+                    "als_sharded", jax.__version__, jax.default_backend(),
+                    getattr(dev, "device_kind", "?"), repr(self.mesh),
+                    self.mode, self.solver, self.cg_steps, self.gather_dtype,
+                    source.shape, target.shape, tuple(b.idx.shape),
+                ),
+                name="als_sharded",
+            )
+            self._executables[key] = compiled
+            stats["compile_s"] += c_s
+            stats["compile_sources"].add(tag)
+        return compiled(*args)
+
+    def half_sweep(self, source, target, buckets, reg, alpha, stats, streamed=False):
+        """One sharded half-sweep: psum Gramian, then every bucket's gather
+        -> solve -> scatter. ``buckets`` yields HOST buckets when
+        ``streamed`` (uploaded one at a time, ``als.shard.stream`` firing
+        per upload) and device buckets otherwise."""
+        SHARD_GATHER_FAULT.hit()
+        yty = self._gramian(source)
+        for b in buckets:
+            if streamed:
+                SHARD_STREAM_FAULT.hit()
+                t0 = time.perf_counter()
+                b = self.put_bucket(b)  # async dispatch; overlaps the solves
+                stats["upload_s"] += time.perf_counter() - t0
+                stats["streamed_buckets"] += 1
+            target = self._run_bucket(source, yty, target, b, reg, alpha, stats)
+        return target
+
+    def fit(
+        self,
+        user_f,
+        item_f,
+        user_buckets,
+        item_buckets,
+        reg: float,
+        alpha: float,
+        n_iter: int,
+        streamed: bool = False,
+        callback=None,
+    ) -> tuple[jax.Array, jax.Array, dict]:
+        """Run ``n_iter`` full sweeps; returns ``(user_f, item_f, stats)``
+        with the factor tables trimmed back to their unpadded row counts.
+
+        ``user_buckets`` / ``item_buckets`` are lists of host buckets, or
+        zero-arg callables returning a fresh iterable per half-sweep — the
+        disk-backed scale harness streams each half-sweep's buckets from
+        spill files through such a provider without ever holding the whole
+        side in memory.
+        """
+        n_users, n_items = int(user_f.shape[0]), int(item_f.shape[0])
+        u_provider = user_buckets if callable(user_buckets) else (lambda: user_buckets)
+        i_provider = item_buckets if callable(item_buckets) else (lambda: item_buckets)
+
+        stats = {
+            "compile_s": 0.0, "compile_sources": set(),
+            "streamed_buckets": 0, "upload_s": 0.0,
+        }
+        user_sh = self.shard_table(user_f)
+        item_sh = self.shard_table(item_f)
+        if not streamed:
+            t0 = time.perf_counter()
+            user_dev = [self.put_bucket(b) for b in u_provider()]
+            item_dev = [self.put_bucket(b) for b in i_provider()]
+            stats["upload_s"] = round(time.perf_counter() - t0, 4)
+        reg_arr = jnp.float32(reg)
+        alpha_arr = jnp.float32(alpha)
+
+        for it in range(int(n_iter)):
+            # MLlib order: item factors first (from users), then users.
+            item_sh = self.half_sweep(
+                user_sh, item_sh,
+                i_provider() if streamed else item_dev,
+                reg_arr, alpha_arr, stats, streamed=streamed,
+            )
+            user_sh = self.half_sweep(
+                item_sh, user_sh,
+                u_provider() if streamed else user_dev,
+                reg_arr, alpha_arr, stats, streamed=streamed,
+            )
+            if callback is not None:
+                callback(
+                    it,
+                    np.asarray(user_sh)[:n_users],
+                    np.asarray(item_sh)[:n_items],
+                )
+        stats["upload_s"] = round(stats["upload_s"], 4)
+        stats["n_shapes"] = len(self._executables)
+        return user_sh[:n_users], item_sh[:n_items], stats
 
 
 class ShardedALSSweep:

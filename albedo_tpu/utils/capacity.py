@@ -164,7 +164,12 @@ class CapacityPlan:
 
 @dataclasses.dataclass(frozen=True)
 class AdmissionVerdict:
-    """The outcome of one admission: verdict + the numbers behind it."""
+    """The outcome of one admission: verdict + the numbers behind it.
+
+    ``chosen`` names the workload of the plan the admission actually
+    selected — for plain :func:`admit` it equals ``workload``; for
+    :func:`admit_ladder` it is the first rung that fit the budget.
+    """
 
     workload: str
     verdict: str  # "fit" | "degrade" | "refuse"
@@ -172,6 +177,7 @@ class AdmissionVerdict:
     budget_bytes: int
     detail: str = ""
     plan: CapacityPlan | None = None
+    chosen: str = ""
 
     @property
     def fits(self) -> bool:
@@ -185,6 +191,8 @@ class AdmissionVerdict:
             "budget_bytes": int(self.budget_bytes),
             "detail": self.detail,
         }
+        if self.chosen:
+            out["chosen"] = self.chosen
         if self.plan is not None:
             out["items"] = {k: int(v) for k, v in self.plan.items.items()}
         return out
@@ -258,6 +266,72 @@ def admit(
     return out
 
 
+def admit_ladder(
+    plans: list[CapacityPlan],
+    *,
+    budget: int | None = None,
+) -> AdmissionVerdict:
+    """Admission over an ordered degradation ladder of priced plans.
+
+    ``plans[0]`` is the preferred mode; each later plan is a cheaper
+    degraded mode. The verdict is ``fit`` when the first plan fits,
+    ``degrade`` when a later rung is the first that fits (``chosen`` names
+    it), and ``refuse`` when no rung fits. Like :func:`admit`, one call =
+    one counted verdict, and the ``capacity.admit`` fault site fires once —
+    an injected ``oom`` forces the preferred rung over budget so the drill
+    lands on the first degraded rung (never a crash).
+    """
+    if not plans:
+        raise ValueError("admit_ladder needs at least one plan")
+    budget = budget_bytes() if budget is None else int(budget)
+    required = [p.required_bytes for p in plans]
+    forced = ""
+    if enabled():
+        try:
+            ADMIT_FAULT.hit()
+        except Exception as e:  # noqa: BLE001 — only OOM converts; rest propagate
+            if not is_resource_exhausted(e):
+                raise
+            forced = f" (forced over-budget by injected fault: {e})"
+            required[0] = max(required[0], budget + 1)
+    if not enabled():
+        chosen = 0
+    else:
+        chosen = next(
+            (i for i, r in enumerate(required) if r <= budget or (forced and i == 1)),
+            len(plans),
+        )
+    if chosen == 0:
+        verdict = "fit"
+        detail = (
+            f"{required[0]:,} bytes within {budget:,}-byte budget"
+        )
+    elif chosen < len(plans):
+        verdict = "degrade"
+        detail = (
+            f"{required[0]:,} bytes over the {budget:,}-byte budget; taking "
+            f"degraded rung {chosen} ({plans[chosen].workload}: "
+            f"{required[chosen]:,} bytes){forced}"
+        )
+    else:
+        verdict = "refuse"
+        detail = (
+            f"every rung over the {budget:,}-byte budget "
+            f"({', '.join(f'{p.workload}={r:,}' for p, r in zip(plans, required))})"
+            f"{forced}"
+        )
+    idx = min(chosen, len(plans) - 1)
+    out = AdmissionVerdict(
+        workload=plans[0].workload, verdict=verdict,
+        required_bytes=required[0], budget_bytes=budget, detail=detail,
+        plan=plans[idx], chosen=plans[idx].workload if verdict != "refuse" else "",
+    )
+    events.capacity_verdicts.inc(verdict=verdict, workload=plans[0].workload)
+    if verdict != "fit":
+        log.warning("capacity admission [%s]: %s", plans[0].workload, detail)
+    return out
+
+
 # --- static cost models -------------------------------------------------------
 # All coarse, all conservative-ish, all pure host arithmetic. f32 = 4 bytes;
 # the gather dtype may halve the streamed block. Each model prices what is
@@ -276,8 +350,9 @@ def plan_fit(
     n_items: int,
     rank: int,
     gather_dtype: str | None = None,
+    n_devices: int = 1,
 ) -> CapacityPlan:
-    """Price the device-resident fused ALS fit.
+    """Price the device-resident fused ALS fit, PER DEVICE.
 
     Resident: both factor tables, every uploaded bucket slab (row_ids + idx
     + val + mask for BOTH sides — the whole point of the resident path is
@@ -285,8 +360,15 @@ def plan_fit(
     (``concat(solved_blocks..., target)`` materializes ``n_slots + n_target``
     rank-vectors per half-sweep). Transient: the largest bucket's gathered
     ``(B, L, rank)`` block plus its ``(B, rank, rank)`` Gramian correction.
+
+    ``n_devices > 1`` prices the GSPMD mesh-resident path: factor tables
+    (and the landing pool's target segment) stay REPLICATED per device,
+    while slabs, solved-slot pools, and transients split over the batch
+    axis — the replicated tables are exactly why this path stops scaling
+    and the fully sharded plan (:func:`plan_fit_sharded`) takes over.
     """
     gb = _dtype_bytes(gather_dtype)
+    n = max(1, int(n_devices))
     tables = (n_users + n_items) * rank * 4
     slabs = 0
     slots_u = slots_i = 0
@@ -299,15 +381,85 @@ def plan_fit(
             else:
                 slots_i += b
             transient = max(transient, b * ln * (rank * gb + gb) + b * rank * rank * 4)
-    landing = (slots_u + n_users + slots_i + n_items) * rank * 4
+    landing = ((slots_u + slots_i) // n + n_users + n_items) * rank * 4
     return CapacityPlan(
         workload="als_fit",
         items={
             "factor_tables": tables,
-            "bucket_slabs": slabs,
+            "bucket_slabs": slabs // n,
             "landing_pools": landing,
-            "transient_gather": transient,
+            "transient_gather": transient // n,
         },
+    )
+
+
+def _shard_pad(n: int, n_devices: int) -> int:
+    return -(-n // n_devices) * n_devices
+
+
+def plan_fit_sharded(
+    bucket_shapes_user: list[tuple[int, int]],
+    bucket_shapes_item: list[tuple[int, int]],
+    n_users: int,
+    n_items: int,
+    rank: int,
+    n_devices: int,
+    gather_dtype: str | None = None,
+    streamed: bool = False,
+    mode: str = "allgather",
+    solver: str = "cholesky",
+) -> CapacityPlan:
+    """Price the fully sharded ALS fit (ALX layout), PER DEVICE.
+
+    Resident: 1/n of BOTH row-sharded factor tables, plus (non-streamed)
+    1/n of every bucket slab. Streamed mode keeps only the single largest
+    bucket's slab shard in flight — the star matrix is never device-resident
+    whole. Transient, per bucket: the assembled source factors — the FULL
+    (padded) table under ``mode="allgather"``, a double-buffered 1/n shard
+    ring slot under ``mode="ring"`` — plus the local gathered block, its
+    Gramian correction, and the all-gathered solved rows of the bucket. The
+    CG solver additionally all-gathers the target table for its warm-start
+    rows, so its transient prices BOTH tables under all-gather.
+    """
+    gb = _dtype_bytes(gather_dtype)
+    n = max(1, int(n_devices))
+    u_pad, i_pad = _shard_pad(n_users, n), _shard_pad(n_items, n)
+    tables = (u_pad + i_pad) * rank * 4 // n
+    slabs = 0
+    worst_slab = 0
+    transient = 0
+    for shapes, src_rows, tgt_rows in (
+        (bucket_shapes_user, i_pad, u_pad),  # user solves gather item factors
+        (bucket_shapes_item, u_pad, i_pad),
+    ):
+        if mode == "ring":
+            # Two ring slots in flight (the held shard + the arriving one).
+            assembled = 2 * (src_rows // n) * rank * gb
+        else:
+            assembled = src_rows * rank * gb
+            if solver == "cg":
+                assembled += tgt_rows * rank * 4  # warm-start gather
+        for b, ln in shapes:
+            slab = b * 4 + b * ln * (4 + 4 + 1)
+            slabs += slab // n
+            worst_slab = max(worst_slab, slab // n)
+            local = (
+                (b // n) * ln * (rank * gb + gb)
+                + (b // n) * rank * rank * 4
+                + b * rank * 4  # all-gathered solved rows land on every device
+            )
+            transient = max(transient, assembled + local)
+    items = {
+        "factor_table_shards": tables,
+        "transient_assembly": transient,
+    }
+    if streamed:
+        items["streamed_slab_in_flight"] = worst_slab
+    else:
+        items["bucket_slab_shards"] = slabs
+    return CapacityPlan(
+        workload="als_fit_sharded_streamed" if streamed else "als_fit_sharded",
+        items=items,
     )
 
 

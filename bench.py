@@ -1538,11 +1538,213 @@ def capacity_bench() -> dict:
     }
 
 
+def scale_bench() -> dict:
+    """The `scale` scenario: ALX-style weak scaling of the fully sharded fit.
+
+    Fixed work PER CHIP (``users_per_chip`` rows of a power-law star matrix,
+    item catalog fixed), device counts walked up 1 -> 2 -> 4 -> 8: each rung
+    generates its matrix OUT-OF-CORE (``datasets.synthetic.
+    generate_scale_dataset``), streams the interaction buckets from disk
+    through the row-sharded fit (``parallel.als.ShardedALSFit``, both factor
+    tables sharded, ``streamed=True`` so the star matrix is never
+    device-resident whole), and reports the median per-sweep wall-clock plus
+    the achieved streamed GB/s per chip from the explicit bytes model. Ideal
+    weak scaling is a FLAT per-sweep curve; ``efficiency`` = t(1 chip) /
+    t(n chips). The record also carries the largest-fittable-matrix estimate
+    per mode from the ``plan_fit_sharded`` cost model against the detected
+    per-device budget, and is written to MULTICHIP_r06.json
+    (``ALBEDO_SCALE_OUT`` overrides the path).
+
+    Env knobs: ALBEDO_SCALE_USERS_PER_CHIP/ITEMS/MEAN_STARS/RANK/SWEEPS/
+    DEVICES/MODE/SOLVER/HOST_DEVICES/OUT. Defaults are CPU-smoke sized; a
+    TPU slice runs the same scenario with real chips and 10M-row shards.
+    """
+    import statistics
+    import tempfile
+
+    # The CPU bench box needs virtual devices BEFORE jax initializes; a real
+    # slice (neither platform env pinned to cpu) uses its hardware devices
+    # untouched. Both pinning styles count: JAX_PLATFORMS and bench.py's own
+    # ALBEDO_BENCH_PLATFORM (the sitecustomize-safe config-update route).
+    host_devs = int(os.environ.get("ALBEDO_SCALE_HOST_DEVICES", "8"))
+    cpu_pinned = "cpu" in (
+        os.environ.get("JAX_PLATFORMS", ""),
+        os.environ.get("ALBEDO_BENCH_PLATFORM", ""),
+    )
+    if (
+        cpu_pinned
+        and host_devs > 1
+        and "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", "")
+    ):
+        os.environ["XLA_FLAGS"] = (
+            f"{os.environ.get('XLA_FLAGS', '')} "
+            f"--xla_force_host_platform_device_count={host_devs}"
+        ).strip()
+
+    import jax
+    import numpy as np
+
+    from albedo_tpu.datasets.synthetic import generate_scale_dataset
+    from albedo_tpu.parallel import make_mesh
+    from albedo_tpu.parallel.als import ShardedALSFit
+    from albedo_tpu.utils import capacity
+    from albedo_tpu.utils.watchdog import factor_health, health_dict
+
+    users_per_chip = int(os.environ.get("ALBEDO_SCALE_USERS_PER_CHIP", "3000"))
+    n_items = int(os.environ.get("ALBEDO_SCALE_ITEMS", "1500"))
+    mean_stars = float(os.environ.get("ALBEDO_SCALE_MEAN_STARS", "20"))
+    rank = int(os.environ.get("ALBEDO_SCALE_RANK", "16"))
+    sweeps = int(os.environ.get("ALBEDO_SCALE_SWEEPS", "3"))
+    mode = os.environ.get("ALBEDO_SCALE_MODE", "allgather")
+    solver = os.environ.get("ALBEDO_SCALE_SOLVER", "cholesky")
+    counts = [
+        int(c) for c in os.environ.get("ALBEDO_SCALE_DEVICES", "1,2,4,8").split(",")
+    ]
+    visible = len(jax.devices())
+    counts = [c for c in counts if c <= visible]
+    if not counts:
+        fail("scale", f"no requested device count fits the {visible} visible")
+
+    gb = 4  # f32 gathers on this scenario
+    curve = []
+    for n in counts:
+        n_users = users_per_chip * n
+        with tempfile.TemporaryDirectory() as d:
+            ds = generate_scale_dataset(
+                d, n_users=n_users, n_items=n_items, mean_stars=mean_stars,
+                seed=42, chunk_users=max(1024, users_per_chip),
+                batch_size=1024,
+            )
+            mesh = make_mesh(n)
+            engine = ShardedALSFit(mesh, solver=solver, mode=mode)
+            rng = np.random.default_rng(0)
+            scale0 = 1.0 / np.sqrt(rank)
+            uf = rng.normal(0, scale0, (n_users, rank)).astype(np.float32)
+            vf = rng.normal(0, scale0, (n_items, rank)).astype(np.float32)
+
+            # Warmup sweep compiles every bucket-shape executable.
+            engine.fit(uf, vf, ds.provider("user"), ds.provider("item"),
+                       0.5, 40.0, 1, streamed=True)
+            per_sweep = []
+            for _ in range(max(1, sweeps)):
+                t0 = time.perf_counter()
+                u_out, i_out, stats = engine.fit(
+                    uf, vf, ds.provider("user"), ds.provider("item"),
+                    0.5, 40.0, 1, streamed=True,
+                )
+                # The watchdog health read is the completion barrier.
+                health = health_dict(factor_health(u_out, i_out))
+                per_sweep.append(time.perf_counter() - t0 - stats["compile_s"])
+            if health["nonfinite"]:
+                fail("scale", f"non-finite factors at {n} devices")
+            sweep_s = statistics.median(per_sweep)
+
+            # Explicit per-chip bytes model for one full sweep (both halves):
+            # streamed slab upload + the local gathered block traffic + the
+            # assembled source tables + the solved-row all-gathers.
+            u_pad = -(-n_users // n) * n
+            i_pad = -(-n_items // n) * n
+            bytes_chip = 0
+            for side, src_pad in (("user", i_pad), ("item", u_pad)):
+                shapes = ds.bucket_shapes(side)
+                slab = sum(b * 4 + b * ln * 9 for b, ln in shapes)
+                gathered = sum(b * ln for b, ln in shapes) * (rank * gb + gb)
+                solved = sum(b for b, _ in shapes) * rank * 4
+                # Both assembly modes move one full source table per bucket
+                # past each chip: all-gather receives it whole, the ring
+                # receives it as n shard visits of table/n bytes each.
+                assembled = len(shapes) * src_pad * rank * gb
+                bytes_chip += (slab + gathered) // n + solved + assembled
+            curve.append({
+                "n_devices": n,
+                "n_users": n_users,
+                "n_items": n_items,
+                "nnz": ds.nnz,
+                "per_sweep_s": round(sweep_s, 4),
+                "per_sweep_trials": [round(t, 4) for t in per_sweep],
+                "achieved_gbps_per_chip": round(bytes_chip / max(sweep_s, 1e-9) / 1e9, 3),
+                "streamed_buckets_per_sweep": stats["streamed_buckets"],
+            })
+
+    base_s = curve[0]["per_sweep_s"]
+    for row in curve:
+        row["efficiency_vs_1chip"] = round(base_s / max(row["per_sweep_s"], 1e-9), 3)
+
+    # Largest-fittable-matrix estimate: walk the user count up until the
+    # streamed sharded plan busts the detected per-device budget, with a
+    # representative bucket-shape model (batch_size x mean row length).
+    budget = capacity.budget_bytes()
+    n_dev = counts[-1]
+
+    def fits(n_users_probe: int, probe_mode: str) -> bool:
+        b, ln = 8192, max(8, int(mean_stars))
+        shapes_u = [(b, ln)] * max(1, n_users_probe // b)
+        shapes_i = [(b, ln)] * max(1, n_items // b)
+        plan = capacity.plan_fit_sharded(
+            shapes_u, shapes_i, n_users_probe, n_items, rank, n_dev,
+            streamed=True, mode=probe_mode, solver=solver,
+        )
+        return plan.required_bytes <= budget
+
+    largest = {}
+    for probe_mode in ("allgather", "ring"):
+        lo, hi = 1, 1
+        while fits(hi, probe_mode) and hi < 1 << 34:
+            lo, hi = hi, hi * 2
+        while lo + 1 < hi:
+            mid = (lo + hi) // 2
+            lo, hi = (mid, hi) if fits(mid, probe_mode) else (lo, mid)
+        largest[probe_mode] = {
+            "max_users": lo,
+            "n_items": n_items,
+            "rank": rank,
+            "n_devices": n_dev,
+            "budget_bytes_per_device": budget,
+        }
+
+    forced_virtual = "xla_force_host_platform_device_count" in os.environ.get(
+        "XLA_FLAGS", ""
+    )
+    record = {
+        "metric": "sharded_als_weak_scaling",
+        "unit": "per-sweep wall-clock s at max device count (weak scaling)",
+        "value": curve[-1]["per_sweep_s"],
+        "scale_note": (
+            "VIRTUAL devices: all device counts share this host's physical "
+            "cores, so efficiency_vs_1chip measures core oversubscription, "
+            "not ICI scaling — this record validates the path and the bytes "
+            "model; the flat-curve claim needs a real slice"
+        ) if forced_virtual and jax.default_backend() == "cpu" else
+        "real devices: efficiency_vs_1chip is the weak-scaling figure",
+        "weak_scaling": curve,
+        "largest_fittable": largest,
+        "mode": mode,
+        "solver": solver,
+        "rank": rank,
+        "users_per_chip": users_per_chip,
+        "mean_stars": mean_stars,
+        "backend": jax.default_backend(),
+        "device_kind": getattr(jax.devices()[0], "device_kind", "?"),
+    }
+    out_path = os.environ.get(
+        "ALBEDO_SCALE_OUT",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)), "MULTICHIP_r06.json"),
+    )
+    try:
+        with open(out_path, "w") as f:
+            json.dump(record, f, indent=2)
+            f.write("\n")
+    except OSError as e:
+        record["record_write_error"] = repr(e)
+    return record
+
+
 SCENARIOS = {
     "serving": serving_bench,
     "datacheck": datacheck_bench,
     "foldin": foldin_bench,
     "capacity": capacity_bench,
+    "scale": scale_bench,
 }
 
 

@@ -124,15 +124,23 @@ class TestAdmissionWiring:
         assert report["health"]["nonfinite"] == 0
         assert report["device_s"] >= 0
 
-    def test_mesh_path_skips_admission(self, monkeypatch):
-        """Sharded fits are the ESCAPE from single-device capacity — the
-        single-device admission must not reroute them."""
+    def test_mesh_path_never_reroutes_to_single_device_chunked(self, monkeypatch):
+        """Mesh fits run their OWN admission ladder (replicated -> sharded
+        -> sharded+streamed, `tests/test_sharded_als.py`) — never the
+        single-device chunked reroute. A budget too small for even the
+        replicated mesh layout lands on a SHARDED rung, not on
+        `mode: chunked`."""
         from albedo_tpu.parallel.mesh import make_mesh
 
-        monkeypatch.setenv("ALBEDO_DEVICE_MEM_BYTES", "1000")
         m = _matrix(seed=7)
         mesh = make_mesh(2)
         est = ImplicitALS(rank=8, max_iter=1, seed=0, batch_size=16, mesh=mesh)
+        streamed_bytes = capacity.plan_fit_sharded(
+            *est._plan_shapes(m), m.n_users, m.n_items, est.rank, 2,
+            streamed=True,
+        ).required_bytes
+        monkeypatch.setenv("ALBEDO_MEM_HEADROOM", "1.0")
+        monkeypatch.setenv("ALBEDO_DEVICE_MEM_BYTES", str(streamed_bytes + 64))
         model = est.fit(m)
         assert np.isfinite(model.user_factors).all()
-        assert est.last_fit_report["mode"] == "resident"
+        assert est.last_fit_report["mode"] in ("sharded", "sharded_streamed")

@@ -10,6 +10,7 @@ import subprocess
 import sys
 
 import numpy as np
+import pytest
 
 import bench
 from albedo_tpu.datasets.synthetic import synthetic_stars
@@ -112,3 +113,30 @@ def test_watchdog_partial_status_field():
     """The watchdog re-emit carries status=partial (ADVICE r4 #1 contract)."""
     record = bench.error_record("x", "y")
     assert "status" not in record  # hard failures carry stage/error instead
+
+
+@pytest.mark.slow
+def test_scale_scenario_record_shape(monkeypatch, tmp_path):
+    """Micro-size run of the `scale` weak-scaling scenario: the record must
+    carry the full curve (per-sweep wall-clock, GB/s per chip, efficiency),
+    the largest-fittable estimates for both assembly modes, and land in
+    MULTICHIP_r06.json."""
+    out = tmp_path / "MULTICHIP_r06.json"
+    monkeypatch.setenv("ALBEDO_SCALE_USERS_PER_CHIP", "200")
+    monkeypatch.setenv("ALBEDO_SCALE_ITEMS", "100")
+    monkeypatch.setenv("ALBEDO_SCALE_MEAN_STARS", "5")
+    monkeypatch.setenv("ALBEDO_SCALE_SWEEPS", "1")
+    monkeypatch.setenv("ALBEDO_SCALE_DEVICES", "1,2")
+    monkeypatch.setenv("ALBEDO_SCALE_OUT", str(out))
+    rec = bench.scale_bench()
+    assert rec["metric"] == "sharded_als_weak_scaling"
+    assert [row["n_devices"] for row in rec["weak_scaling"]] == [1, 2]
+    for row in rec["weak_scaling"]:
+        assert row["per_sweep_s"] > 0
+        assert row["achieved_gbps_per_chip"] > 0
+        assert row["streamed_buckets_per_sweep"] > 0
+        assert row["n_users"] == 200 * row["n_devices"]  # fixed work per chip
+    assert rec["weak_scaling"][0]["efficiency_vs_1chip"] == 1.0
+    for mode in ("allgather", "ring"):
+        assert rec["largest_fittable"][mode]["max_users"] > 0
+    assert json.loads(out.read_text())["metric"] == "sharded_als_weak_scaling"

@@ -102,6 +102,108 @@ class TestPlans:
         assert all(b >= 1 and ln >= 1 for b, ln in shapes)
 
 
+class TestShardedPlans:
+    SHAPES = [(64, 64), (32, 128), (128, 16)]
+
+    def test_per_device_bytes_shrink_with_devices(self):
+        p1 = capacity.plan_fit_sharded(self.SHAPES, self.SHAPES, 4000, 2000, 16, 1)
+        p8 = capacity.plan_fit_sharded(self.SHAPES, self.SHAPES, 4000, 2000, 16, 8)
+        assert p8.required_bytes < p1.required_bytes
+
+    def test_streamed_keeps_one_slab_in_flight(self):
+        resident = capacity.plan_fit_sharded(
+            self.SHAPES, self.SHAPES, 4000, 2000, 16, 8, streamed=False
+        )
+        streamed = capacity.plan_fit_sharded(
+            self.SHAPES, self.SHAPES, 4000, 2000, 16, 8, streamed=True
+        )
+        assert streamed.required_bytes < resident.required_bytes
+        assert "streamed_slab_in_flight" in streamed.items
+        assert "bucket_slab_shards" in resident.items
+        assert (
+            streamed.items["streamed_slab_in_flight"]
+            < resident.items["bucket_slab_shards"]
+        )
+
+    def test_ring_transient_below_allgather(self):
+        # Ring never materializes a full table: at large table sizes its
+        # per-device transient is a fraction of the all-gather mode's.
+        ag = capacity.plan_fit_sharded(
+            self.SHAPES, self.SHAPES, 10**6, 10**5, 32, 8, mode="allgather"
+        )
+        ring = capacity.plan_fit_sharded(
+            self.SHAPES, self.SHAPES, 10**6, 10**5, 32, 8, mode="ring"
+        )
+        assert ring.items["transient_assembly"] < ag.items["transient_assembly"]
+
+    def test_cg_prices_the_target_assembly_too(self):
+        chol = capacity.plan_fit_sharded(
+            self.SHAPES, self.SHAPES, 10**5, 10**5, 32, 8, solver="cholesky"
+        )
+        cg = capacity.plan_fit_sharded(
+            self.SHAPES, self.SHAPES, 10**5, 10**5, 32, 8, solver="cg"
+        )
+        assert cg.items["transient_assembly"] > chol.items["transient_assembly"]
+
+    def test_mesh_resident_divides_slabs_not_tables(self):
+        one = capacity.plan_fit(self.SHAPES, self.SHAPES, 4000, 2000, 16)
+        eight = capacity.plan_fit(
+            self.SHAPES, self.SHAPES, 4000, 2000, 16, n_devices=8
+        )
+        assert eight.items["factor_tables"] == one.items["factor_tables"]
+        assert eight.items["bucket_slabs"] < one.items["bucket_slabs"]
+
+    def test_sharded_tables_scale_down_with_devices(self):
+        p2 = capacity.plan_fit_sharded(self.SHAPES, self.SHAPES, 4000, 2000, 16, 2)
+        p8 = capacity.plan_fit_sharded(self.SHAPES, self.SHAPES, 4000, 2000, 16, 8)
+        assert p8.items["factor_table_shards"] < p2.items["factor_table_shards"]
+
+
+class TestAdmitLadder:
+    def _ladder(self):
+        return [
+            capacity.CapacityPlan("a", {"x": 1000}),
+            capacity.CapacityPlan("b", {"x": 500}),
+            capacity.CapacityPlan("c", {"x": 100}),
+        ]
+
+    def test_first_rung_fits(self):
+        v = capacity.admit_ladder(self._ladder(), budget=2000)
+        assert v.verdict == "fit" and v.chosen == "a"
+
+    def test_degrade_picks_first_fitting_rung(self):
+        v = capacity.admit_ladder(self._ladder(), budget=600)
+        assert v.verdict == "degrade" and v.chosen == "b"
+        v = capacity.admit_ladder(self._ladder(), budget=200)
+        assert v.verdict == "degrade" and v.chosen == "c"
+
+    def test_refuse_when_no_rung_fits(self):
+        v = capacity.admit_ladder(self._ladder(), budget=50)
+        assert v.verdict == "refuse" and v.chosen == ""
+        assert "every rung" in v.detail
+
+    def test_one_counted_verdict_per_call(self):
+        before = events.capacity_verdicts.value(verdict="degrade", workload="a")
+        capacity.admit_ladder(self._ladder(), budget=600)
+        assert events.capacity_verdicts.value(
+            verdict="degrade", workload="a"
+        ) == before + 1
+
+    def test_injected_oom_lands_on_the_second_rung(self):
+        faults.arm("capacity.admit", kind="oom", at=1)
+        v = capacity.admit_ladder(self._ladder(), budget=10**9)
+        assert v.verdict == "degrade" and v.chosen == "b"
+        assert "injected" in v.detail
+
+    def test_empty_ladder_rejected(self):
+        with pytest.raises(ValueError, match="at least one"):
+            capacity.admit_ladder([], budget=100)
+
+    def test_verdict_to_dict_carries_chosen(self):
+        v = capacity.admit_ladder(self._ladder(), budget=600)
+        assert v.to_dict()["chosen"] == "b"
+
+
 # --- admission ----------------------------------------------------------------
 
 

@@ -101,3 +101,29 @@ class TestDegradedFitParity:
         np.testing.assert_allclose(
             degraded.item_factors, full.item_factors, atol=1e-5
         )
+
+    def test_sharded_fit_parity_down_the_ladder(self):
+        """The ALX-layout fit under degradation: the SAME matrix trained
+        with row-sharded tables + streamed buckets on 8, 4 (fault-degraded
+        from 8), and 2 devices must land the same factors — fewer shards
+        means slower and bigger table shards, never different numbers."""
+        matrix = synthetic_stars(n_users=64, n_items=48, mean_stars=6, seed=3)
+        kw = dict(
+            rank=8, max_iter=2, batch_size=32, seed=0, sharded="streamed"
+        )
+        full = ImplicitALS(**kw, mesh=make_mesh(8)).fit(matrix)
+
+        faults.arm("mesh.devices", kind="error", at=1)
+        mesh4 = make_mesh(8)  # half the slice drops out -> 4 devices
+        assert mesh4.shape[DATA_AXIS] == 4
+        ladder = [mesh4, make_mesh(2)]
+        for mesh in ladder:
+            est = ImplicitALS(**kw, mesh=mesh)
+            got = est.fit(matrix)
+            assert est.last_fit_report["mode"] == "sharded_streamed"
+            np.testing.assert_allclose(
+                got.user_factors, full.user_factors, atol=1e-5
+            )
+            np.testing.assert_allclose(
+                got.item_factors, full.item_factors, atol=1e-5
+            )

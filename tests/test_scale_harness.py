@@ -1,0 +1,136 @@
+"""The out-of-core scale harness (``datasets.synthetic.generate_scale_dataset``):
+deterministic bucket-by-bucket generation, user/item side consistency, and a
+disk-streamed sharded fit matching the in-memory resident fit. Giant shapes
+are env-gated and marked slow — CI exercises the identical code path at toy
+sizes."""
+
+import os
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from albedo_tpu.datasets.synthetic import (  # noqa: E402
+    ScaleDataset,
+    generate_scale_dataset,
+)
+from albedo_tpu.models.als import ImplicitALS  # noqa: E402
+from albedo_tpu.parallel import make_mesh  # noqa: E402
+from albedo_tpu.parallel.als import ShardedALSFit  # noqa: E402
+
+GEN_KW = dict(
+    n_users=200, n_items=96, mean_stars=6, seed=5,
+    chunk_users=64, n_partitions=3, batch_size=32,
+)
+
+
+@pytest.fixture(scope="module")
+def dataset(tmp_path_factory):
+    root = tmp_path_factory.mktemp("scale-ds")
+    return generate_scale_dataset(root, **GEN_KW)
+
+
+class TestGeneration:
+    def test_deterministic_per_seed(self, dataset, tmp_path):
+        again = generate_scale_dataset(tmp_path / "again", **GEN_KW)
+        a, b = dataset.to_star_matrix(), again.to_star_matrix()
+        assert np.array_equal(a.rows, b.rows)
+        assert np.array_equal(a.cols, b.cols)
+        other = generate_scale_dataset(
+            tmp_path / "other", **dict(GEN_KW, seed=6)
+        )
+        assert other.nnz != dataset.nnz or not np.array_equal(
+            other.to_star_matrix().cols, a.cols
+        )
+
+    def test_sides_are_consistent(self, dataset):
+        # Every interaction appears exactly once on EACH side's buckets.
+        m = dataset.to_star_matrix()
+        user_nnz = sum(int(b.mask.sum()) for b in dataset.iter_buckets("user"))
+        item_nnz = sum(int(b.mask.sum()) for b in dataset.iter_buckets("item"))
+        assert user_nnz == item_nnz == dataset.nnz == m.nnz
+        # The item side's (row=item, idx=user) entries transpose back to the
+        # exact same pair set the user side packed.
+        pairs_u, pairs_i = set(), set()
+        for b in dataset.iter_buckets("user"):
+            for rid, row_idx, row_mask in zip(b.row_ids, b.idx, b.mask):
+                if rid >= 0:
+                    pairs_u.update((int(rid), int(c)) for c in row_idx[row_mask])
+        for b in dataset.iter_buckets("item"):
+            for rid, row_idx, row_mask in zip(b.row_ids, b.idx, b.mask):
+                if rid >= 0:
+                    pairs_i.update((int(u), int(rid)) for u in row_idx[row_mask])
+        assert pairs_u == pairs_i
+
+    def test_row_ids_are_global_and_in_range(self, dataset):
+        seen_users = set()
+        for b in dataset.iter_buckets("user"):
+            rid = b.row_ids[b.row_ids >= 0]
+            assert rid.max() < dataset.n_users
+            assert not (set(rid.tolist()) & seen_users), "user split across chunks"
+            seen_users.update(rid.tolist())
+        for b in dataset.iter_buckets("item"):
+            rid = b.row_ids[b.row_ids >= 0]
+            assert rid.max() < dataset.n_items
+
+    def test_power_law_popularity(self, dataset):
+        counts = np.sort(dataset.to_star_matrix().item_counts())[::-1]
+        top = counts[: max(1, len(counts) // 10)].sum()
+        assert top > 0.2 * counts.sum()  # head-heavy, as GitHub stars are
+
+    def test_meta_shapes_match_stored_buckets(self, dataset):
+        for side in ("user", "item"):
+            stored = {b.shape for b in dataset.iter_buckets(side)}
+            assert stored == set(dataset.bucket_shapes(side))
+
+    def test_reopen_from_disk(self, dataset):
+        reopened = ScaleDataset(dataset.root)
+        assert reopened.nnz == dataset.nnz
+        assert sum(1 for _ in reopened.iter_buckets("user")) == sum(
+            1 for _ in dataset.iter_buckets("user")
+        )
+
+
+class TestDiskStreamedFit:
+    def test_matches_in_memory_resident_fit(self, dataset):
+        m = dataset.to_star_matrix()
+        ref = ImplicitALS(
+            rank=8, max_iter=2, batch_size=32, seed=1, chunked=False
+        ).fit(m)
+        key = jax.random.PRNGKey(1)
+        uk, ik = jax.random.split(key)
+        scale = 1.0 / np.sqrt(8)
+        uf = np.asarray(jax.random.normal(uk, (m.n_users, 8))) * scale
+        vf = np.asarray(jax.random.normal(ik, (m.n_items, 8))) * scale
+        engine = ShardedALSFit(make_mesh(8))
+        u2, v2, stats = engine.fit(
+            uf.astype(np.float32), vf.astype(np.float32),
+            dataset.provider("user"), dataset.provider("item"),
+            0.5, 40.0, 2, streamed=True,
+        )
+        np.testing.assert_allclose(
+            np.asarray(u2), ref.user_factors, atol=1e-5
+        )
+        np.testing.assert_allclose(
+            np.asarray(v2), ref.item_factors, atol=1e-5
+        )
+        assert stats["streamed_buckets"] > 0
+
+
+@pytest.mark.slow
+def test_scale_dataset_large_env_gated(tmp_path):
+    """Giant-shape smoke, env-gated so the weak-scaling record's data path
+    is testable at real sizes without burdening CI: e.g.
+    ``ALBEDO_SCALE_TEST_USERS=1000000 ALBEDO_SCALE_TEST_ITEMS=100000``."""
+    n_users = int(os.environ.get("ALBEDO_SCALE_TEST_USERS", "50000"))
+    n_items = int(os.environ.get("ALBEDO_SCALE_TEST_ITEMS", "5000"))
+    ds = generate_scale_dataset(
+        tmp_path / "big", n_users=n_users, n_items=n_items,
+        mean_stars=float(os.environ.get("ALBEDO_SCALE_TEST_MEAN_STARS", "12")),
+        chunk_users=8192, seed=7,
+    )
+    assert ds.nnz > n_users  # every user stars at least once
+    user_nnz = sum(int(b.mask.sum()) for b in ds.iter_buckets("user"))
+    item_nnz = sum(int(b.mask.sum()) for b in ds.iter_buckets("item"))
+    assert user_nnz == item_nnz == ds.nnz

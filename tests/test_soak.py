@@ -132,6 +132,19 @@ def test_soak_smoke(monkeypatch):
         for leg in cycle["legs"]:
             assert leg["rc"] in (0, 1, 3, 4, 75), (cycle["cycle"], leg)
         assert cycle["invariant_violations"] == []
+    # The mesh leg drives a row-sharded streamed fit every cycle, and the
+    # schedule pins an `als.shard.gather` arm on one smoke cycle — the
+    # sharded path's chaos surface must have been OBSERVED firing.
+    mesh_legs = [
+        leg
+        for cycle in report["cycles"]
+        for leg in cycle["legs"]
+        if leg["job"] == "mesh_boot"
+    ]
+    assert all("sharded_fit" in leg for leg in mesh_legs)
+    assert any(
+        leg["fired"].get("als.shard.gather", 0) > 0 for leg in mesh_legs
+    )
     # The report is a sealed artifact-store product.
     report_path = get_settings().artifact_dir / REPORT_NAME
     assert report_path.exists()
