@@ -12,7 +12,7 @@ JOBS = popularity curation content train_als cv_als build_user_profile \
 
 .PHONY: $(JOBS) test test-all bench serve-bench datacheck-bench chaos \
         chaos-serve chaos-stream stream stream-bench dryrun soak soak-smoke \
-        capacity-bench
+        capacity-bench lint lint-baseline
 
 $(JOBS):
 	$(PY) -m albedo_tpu.cli $@ $(ARGS)
@@ -20,6 +20,19 @@ $(JOBS):
 # Tier-1: the slow-marked load tests run via test-all, not here.
 test:
 	$(PY) -m pytest tests/ -q -m 'not slow'
+
+# graftlint (albedo_tpu/analysis): the repo's JAX-aware static analysis —
+# bare-jit, hidden-host-sync, contract-drift, dtype-discipline,
+# retrace-hazard. Exits 0 only when every finding is fixed, pragma'd with a
+# reason, or baselined (see ARCHITECTURE.md "Static analysis"). Never
+# imports jax — safe anywhere.
+lint:
+	$(PY) -m albedo_tpu.analysis
+
+# Regenerate .graftlint-baseline.json from the current findings. Review the
+# diff: shrinking is progress, growth needs a reason in the PR.
+lint-baseline:
+	$(PY) -m albedo_tpu.analysis --write-baseline
 
 test-all:
 	$(PY) -m pytest tests/ -q

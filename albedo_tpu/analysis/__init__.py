@@ -1,0 +1,51 @@
+"""graftlint — the repo's JAX-aware static analysis pass.
+
+The hardest-won invariants in this codebase are not type errors: "hot paths
+acquire executables through ``utils/aot.py``, never bare ``jax.jit``" (the
+PR 4 cache-corruption root cause), "no hidden host<->device syncs inside the
+fit/fold-in/batcher loops" (the PR 6 fix that cut fold-in cycles 0.09 s ->
+0.003 s), "every counter / fault site / exit code is catalogued". Each has
+been violated and re-fixed at least once at runtime cost, and every new
+shard_map/pjit surface multiplies the places they can silently regress.
+This package makes them cheap to hold forever: an AST lint with
+repo-specific rules, run as a tier-1 test and ``make lint``.
+
+Rules (see ARCHITECTURE.md "Static analysis" for the operator-facing
+catalog):
+
+- ``bare-jit`` (R1): ``jax.jit``/``pjit`` call sites in the device packages
+  that bypass the persistent-executable layer in ``utils/aot.py``.
+- ``hidden-host-sync`` (R2): ``.item()`` / ``.tolist()`` /
+  ``block_until_ready()`` / loop-borne ``float()``/``np.asarray()`` host
+  reads inside functions reachable from the fit/fold-in/batcher hot loops.
+- ``contract-drift`` (R3): the fault-site catalog, the metric-name registry
+  (``utils/events.py``), and the CLI exit-code contract, each checked both
+  directions against code and docs.
+- ``dtype-discipline`` (R4): bf16-capable kernels whose contractions lack an
+  explicit f32 accumulation (``preferred_element_type``).
+- ``retrace-hazard`` (R5): jitted functions whose Python branches read
+  traced parameters, or whose static arguments default to unhashables.
+
+Mechanics: ``# albedo: noqa[rule-id]`` pragmas suppress a finding at its
+line (with a reason — pragmas are documentation); ``.graftlint-baseline.json``
+grandfathers findings that predate a rule; ``python -m albedo_tpu.analysis``
+is the CLI (``--json`` for machines, ``--write-baseline`` to re-baseline).
+"""
+
+from albedo_tpu.analysis.core import (  # noqa: F401
+    BASELINE_NAME,
+    Finding,
+    ProjectTree,
+    Rule,
+    all_rules,
+    apply_baseline,
+    collect_findings,
+    default_tree,
+    load_baseline,
+    write_baseline,
+)
+# Importing the rule modules registers them.
+from albedo_tpu.analysis import rules_device  # noqa: F401
+from albedo_tpu.analysis import rules_contract  # noqa: F401
+from albedo_tpu.analysis import rules_dtype  # noqa: F401
+from albedo_tpu.analysis import rules_retrace  # noqa: F401

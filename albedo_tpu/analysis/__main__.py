@@ -1,0 +1,10 @@
+"""``python -m albedo_tpu.analysis`` — see :mod:`albedo_tpu.analysis.cli`.
+
+Import-safe (test_imports walks every submodule): the CLI only runs under
+``python -m``.
+"""
+
+from albedo_tpu.analysis.cli import main
+
+if __name__ == "__main__":
+    raise SystemExit(main())

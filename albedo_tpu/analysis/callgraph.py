@@ -1,0 +1,152 @@
+"""A name-resolution call graph over the package, for reachability rules.
+
+Deliberately static and conservative: edges are resolved only where the
+import structure makes the target unambiguous (same-module functions,
+``self.method`` within a class, ``from pkg.mod import name`` /
+``import pkg.mod as m`` targets inside the analyzed package). Unresolvable
+calls (stdlib, numpy, dynamic dispatch) simply have no edge — a rule built
+on this graph under-approximates reachability rather than drowning the
+tree in false positives.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Iterator
+
+from albedo_tpu.analysis.core import Module, ProjectTree, dotted_name
+
+
+@dataclasses.dataclass
+class FunctionInfo:
+    module: str              # relpath of the defining module
+    qualname: str            # "Class.method" or "function"
+    name: str                # bare name
+    node: ast.AST            # FunctionDef / AsyncFunctionDef
+    class_name: str | None
+
+
+def _module_rel(package_dotted: str) -> str:
+    """"albedo_tpu.ops.als" -> "albedo_tpu/ops/als.py"."""
+    return package_dotted.replace(".", "/") + ".py"
+
+
+class CallGraph:
+    def __init__(self, tree: ProjectTree):
+        self.tree = tree
+        # (module relpath, qualname) -> FunctionInfo
+        self.functions: dict[tuple[str, str], FunctionInfo] = {}
+        # module relpath -> {local name: (kind, target)} where kind is
+        # "module" (target = module relpath) or "symbol"
+        # (target = (module relpath, symbol name)).
+        self.imports: dict[str, dict[str, tuple[str, object]]] = {}
+        for rel, mod in tree.modules.items():
+            self._index_module(rel, mod)
+
+    # ------------------------------------------------------------- indexing
+    def _index_module(self, rel: str, mod: Module) -> None:
+        imports: dict[str, tuple[str, object]] = {}
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    target = _module_rel(alias.name)
+                    if target in self.tree.modules:
+                        imports[alias.asname or alias.name.split(".")[0]] = (
+                            "module", target,
+                        )
+            elif isinstance(node, ast.ImportFrom) and node.module and node.level == 0:
+                base = _module_rel(node.module)
+                pkg_init = node.module.replace(".", "/") + "/__init__.py"
+                for alias in node.names:
+                    local = alias.asname or alias.name
+                    sub = _module_rel(f"{node.module}.{alias.name}")
+                    if sub in self.tree.modules:
+                        imports[local] = ("module", sub)
+                    elif base in self.tree.modules:
+                        imports[local] = ("symbol", (base, alias.name))
+                    elif pkg_init in self.tree.modules:
+                        imports[local] = ("symbol", (pkg_init, alias.name))
+        self.imports[rel] = imports
+
+        def index_def(node: ast.AST, class_name: str | None) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    qual = f"{class_name}.{child.name}" if class_name else child.name
+                    self.functions[(rel, qual)] = FunctionInfo(
+                        rel, qual, child.name, child, class_name
+                    )
+                    # Nested defs are attributed to their outer function's
+                    # qualname only when reached via the outer body walk in
+                    # callees() — they are not independently addressable.
+                elif isinstance(child, ast.ClassDef) and class_name is None:
+                    index_def(child, child.name)
+
+        index_def(mod.tree, None)
+
+    # ----------------------------------------------------------- resolution
+    def resolve_call(
+        self, caller: FunctionInfo, call: ast.Call
+    ) -> FunctionInfo | None:
+        func = call.func
+        rel = caller.module
+        imports = self.imports.get(rel, {})
+        if isinstance(func, ast.Name):
+            name = func.id
+            hit = self.functions.get((rel, name))
+            if hit is not None:
+                return hit
+            imp = imports.get(name)
+            if imp and imp[0] == "symbol":
+                target_mod, sym = imp[1]  # type: ignore[misc]
+                return self.functions.get((target_mod, sym))
+            return None
+        if isinstance(func, ast.Attribute):
+            base = func.value
+            if isinstance(base, ast.Name) and base.id == "self" and caller.class_name:
+                return self.functions.get(
+                    (rel, f"{caller.class_name}.{func.attr}")
+                )
+            dn = dotted_name(base)
+            if dn is not None:
+                imp = imports.get(dn.split(".")[0])
+                if imp and imp[0] == "module":
+                    return self.functions.get((imp[1], func.attr))  # type: ignore[arg-type]
+                # `from albedo_tpu import ops` style: dn = "ops.als" etc. —
+                # covered above only for single-segment bases; deeper chains
+                # stay unresolved (conservative).
+            return None
+        return None
+
+    def callees(self, fn: FunctionInfo) -> Iterator[FunctionInfo]:
+        for node in ast.walk(fn.node):
+            if isinstance(node, ast.Call):
+                hit = self.resolve_call(fn, node)
+                if hit is not None:
+                    yield hit
+
+    # --------------------------------------------------------- reachability
+    def reachable(
+        self, roots: list[tuple[str, str]], skip_modules: tuple[str, ...] = ()
+    ) -> list[FunctionInfo]:
+        """BFS closure over resolved call edges from (module, qualname)
+        roots. ``skip_modules`` prunes whole files (the watchdog's
+        completion-barrier reads are allowlisted this way)."""
+        seen: dict[tuple[str, str], FunctionInfo] = {}
+        frontier = [
+            self.functions[key]
+            for key in roots
+            if key in self.functions
+        ]
+        for fn in frontier:
+            seen[(fn.module, fn.qualname)] = fn
+        while frontier:
+            fn = frontier.pop()
+            for callee in self.callees(fn):
+                if callee.module in skip_modules:
+                    continue
+                key = (callee.module, callee.qualname)
+                if key not in seen:
+                    seen[key] = callee
+                    frontier.append(callee)
+        return list(seen.values())

@@ -1,0 +1,124 @@
+"""The ``python -m albedo_tpu.analysis`` entry point.
+
+Exit codes follow the repo contract: 0 = clean (every finding baselined or
+suppressed), 1 = non-baselined findings, 2 = usage error. ``--json`` emits a
+machine-readable report; ``--write-baseline`` regenerates the grandfather
+file from the current findings (review the diff — shrinking is progress,
+growth needs a reason in the PR).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+from albedo_tpu.analysis.core import (
+    BASELINE_NAME,
+    ProjectTree,
+    all_rules,
+    apply_baseline,
+    collect_findings,
+    load_baseline,
+    repo_root,
+    write_baseline,
+)
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m albedo_tpu.analysis",
+        description="graftlint: the repo's JAX-aware static analysis pass",
+    )
+    parser.add_argument(
+        "--root", default=None,
+        help="repo root to analyze (default: this checkout)",
+    )
+    parser.add_argument(
+        "--rules", default=None,
+        help="comma-separated rule ids (default: all)",
+    )
+    parser.add_argument("--json", action="store_true", help="machine output")
+    parser.add_argument(
+        "--baseline", default=None,
+        help=f"baseline file (default: <root>/{BASELINE_NAME})",
+    )
+    parser.add_argument(
+        "--no-baseline", action="store_true",
+        help="report every finding, grandfathered or not",
+    )
+    parser.add_argument(
+        "--write-baseline", action="store_true",
+        help="regenerate the baseline from the current findings and exit 0",
+    )
+    parser.add_argument("--list-rules", action="store_true")
+    args = parser.parse_args(argv)
+
+    rules = all_rules()
+    if args.list_rules:
+        for rid, rule in sorted(rules.items()):
+            print(f"{rid:20s} {rule.summary}")
+        return 0
+
+    rule_ids = None
+    if args.rules:
+        rule_ids = [r.strip() for r in args.rules.split(",") if r.strip()]
+        unknown = set(rule_ids) - set(rules)
+        if unknown:
+            print(f"unknown rule id(s): {sorted(unknown)}", file=sys.stderr)
+            return 2
+        if args.write_baseline:
+            # A partial-rule rewrite would silently DELETE every other
+            # rule's grandfathered entries — the baseline is only ever
+            # regenerated from a full run.
+            print(
+                "--write-baseline regenerates the whole baseline and cannot "
+                "be combined with --rules (it would drop every other "
+                "rule's entries)", file=sys.stderr,
+            )
+            return 2
+
+    root = Path(args.root) if args.root else repo_root()
+    if not root.is_dir():
+        print(f"not a directory: {root}", file=sys.stderr)
+        return 2
+    tree = ProjectTree.load(root)
+    findings = collect_findings(tree, rule_ids=rule_ids)
+
+    baseline_path = Path(args.baseline) if args.baseline else root / BASELINE_NAME
+    if args.write_baseline:
+        write_baseline(baseline_path, findings)
+        print(f"wrote {len(findings)} finding(s) to {baseline_path}")
+        return 0
+
+    baseline = [] if args.no_baseline else load_baseline(baseline_path)
+    fresh, grandfathered, stale = apply_baseline(findings, baseline)
+
+    if args.json:
+        print(json.dumps({
+            "findings": [f.to_dict() for f in fresh],
+            "grandfathered": [f.to_dict() for f in grandfathered],
+            "stale_baseline_entries": stale,
+            "rules": sorted(rules if rule_ids is None else rule_ids),
+        }, indent=2))
+    else:
+        for f in fresh:
+            print(f.render())
+            if f.source_line.strip():
+                print(f"    {f.source_line.strip()}")
+        summary = (
+            f"graftlint: {len(fresh)} finding(s), "
+            f"{len(grandfathered)} baselined, {len(stale)} stale baseline "
+            f"entr{'y' if len(stale) == 1 else 'ies'}"
+        )
+        print(summary)
+        if stale:
+            print(
+                "stale baseline entries (finding fixed? run "
+                "--write-baseline and commit the shrink):"
+            )
+            for entry in stale:
+                print(f"    {entry.get('path')}: [{entry.get('rule')}] "
+                      f"{entry.get('message', '')[:80]}")
+    return 1 if fresh else 0

@@ -1,0 +1,321 @@
+"""graftlint core: project model, rule registry, pragmas, baseline.
+
+Everything here is plain ``ast`` + filesystem — the analysis must run in a
+process that never imports jax (CI lint legs, pre-commit), so rules inspect
+source, not live objects. Rules are whole-project passes (they need
+cross-module facts: which jitted functions feed the AOT layer, which
+functions are reachable from a hot loop), so the unit of work is a
+:class:`ProjectTree`, not a file.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import hashlib
+import json
+import re
+from functools import lru_cache
+from pathlib import Path
+from typing import Callable, Iterable, Iterator
+
+BASELINE_NAME = ".graftlint-baseline.json"
+# Fixture snippets are intentionally-violating code: the real sweep must
+# never see them (tests load them as their own little ProjectTrees).
+EXCLUDED_SUBTREES = ("albedo_tpu/analysis/fixtures",)
+# Docs that carry contract surface (R3 reads these when present).
+DOC_FILES = ("ARCHITECTURE.md", "README.md")
+
+_PRAGMA = re.compile(r"#\s*albedo:\s*noqa\[([^\]]+)\]")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One rule violation, anchored to a source line.
+
+    ``fingerprint()`` deliberately ignores the line number: baselines must
+    survive unrelated edits above a grandfathered finding, so identity is
+    (rule, path, normalized source text) — matched as a multiset, so two
+    identical offending lines need two baseline entries.
+    """
+
+    rule: str
+    path: str          # repo-root-relative, posix separators
+    line: int          # 1-based
+    col: int
+    message: str
+    source_line: str = ""
+
+    def fingerprint(self) -> str:
+        basis = f"{self.rule}|{self.path}|{self.source_line.strip()}"
+        return hashlib.sha1(basis.encode("utf-8")).hexdigest()[:16]
+
+    def to_dict(self) -> dict:
+        return {
+            "rule": self.rule, "path": self.path, "line": self.line,
+            "col": self.col, "message": self.message,
+            "fingerprint": self.fingerprint(),
+        }
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: [{self.rule}] {self.message}"
+
+
+class Module:
+    """A parsed source file plus its pragma map."""
+
+    def __init__(self, relpath: str, source: str):
+        self.path = relpath
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=relpath)
+        # line -> set of suppressed rule ids ("*" = all rules).
+        self.pragmas: dict[int, set[str]] = {}
+        for i, text in enumerate(self.lines, start=1):
+            m = _PRAGMA.search(text)
+            if m:
+                ids = {p.strip() for p in m.group(1).split(",") if p.strip()}
+                self.pragmas[i] = ids
+
+    def line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1]
+        return ""
+
+    def suppressed(self, rule: str, lineno: int) -> bool:
+        """A pragma suppresses findings on its own line or the line below —
+        the two idioms are trailing (`x = jax.jit(f)  # albedo: noqa[...]`)
+        and standalone-above (decorator stacks, long calls)."""
+        for ln in (lineno, lineno - 1):
+            ids = self.pragmas.get(ln)
+            if ids and (rule in ids or "*" in ids):
+                return True
+        return False
+
+
+class ProjectTree:
+    """The analyzed universe: parsed package modules + contract docs."""
+
+    def __init__(self, root: Path, modules: dict[str, Module], docs: dict[str, str]):
+        self.root = Path(root)
+        self.modules = modules
+        self.docs = docs
+
+    @classmethod
+    def load(cls, root: Path, package: str = "albedo_tpu") -> "ProjectTree":
+        root = Path(root)
+        modules: dict[str, Module] = {}
+        pkg_dir = root / package
+        for py in sorted(pkg_dir.rglob("*.py")):
+            rel = py.relative_to(root).as_posix()
+            if any(rel == ex or rel.startswith(ex + "/") for ex in EXCLUDED_SUBTREES):
+                continue
+            try:
+                modules[rel] = Module(rel, py.read_text())
+            except SyntaxError as e:
+                raise SyntaxError(f"graftlint cannot parse {rel}: {e}") from e
+        docs = {
+            name: (root / name).read_text()
+            for name in DOC_FILES
+            if (root / name).exists()
+        }
+        return cls(root, modules, docs)
+
+    def in_packages(self, *prefixes: str) -> Iterator[Module]:
+        for rel, mod in self.modules.items():
+            if any(rel.startswith(p) for p in prefixes):
+                yield mod
+
+    def get(self, relpath: str) -> Module | None:
+        return self.modules.get(relpath)
+
+
+def repo_root() -> Path:
+    return Path(__file__).resolve().parents[2]
+
+
+@lru_cache(maxsize=1)
+def default_tree() -> ProjectTree:
+    """The repo's own tree, parsed once per process (tests share it)."""
+    return ProjectTree.load(repo_root())
+
+
+# --- rule registry ------------------------------------------------------------
+
+
+class Rule:
+    """Base class: subclasses set ``id``/``summary`` and implement ``check``.
+
+    Instantiating with keyword overrides reconfigures a rule (tests point
+    ``hidden-host-sync`` at fixture-local hot roots, for example); the
+    module-level registry holds the default-configured instance.
+    """
+
+    id: str = ""
+    summary: str = ""
+
+    def check(self, tree: ProjectTree) -> Iterator[Finding]:  # pragma: no cover
+        raise NotImplementedError
+
+
+_RULES: dict[str, Rule] = {}
+
+
+def register(rule_cls: type[Rule]) -> type[Rule]:
+    inst = rule_cls()
+    if not inst.id:
+        raise ValueError(f"{rule_cls.__name__} has no rule id")
+    if inst.id in _RULES:
+        raise ValueError(f"duplicate rule id {inst.id!r}")
+    _RULES[inst.id] = inst
+    return rule_cls
+
+
+def all_rules() -> dict[str, Rule]:
+    # Rule modules register on import; importing the package wires them.
+    import albedo_tpu.analysis  # noqa: F401
+
+    return dict(_RULES)
+
+
+def collect_findings(
+    tree: ProjectTree,
+    rules: Iterable[Rule] | None = None,
+    rule_ids: Iterable[str] | None = None,
+) -> list[Finding]:
+    """Run rules over the tree; pragma-suppressed findings are dropped here
+    (suppression is a property of the code, not of the caller)."""
+    if rules is None:
+        registry = all_rules()
+        if rule_ids is not None:
+            unknown = set(rule_ids) - set(registry)
+            if unknown:
+                raise KeyError(f"unknown rule id(s): {sorted(unknown)}")
+            rules = [registry[i] for i in rule_ids]
+        else:
+            rules = list(registry.values())
+    out: list[Finding] = []
+    for rule in rules:
+        for f in rule.check(tree):
+            mod = tree.get(f.path)
+            if mod is not None and mod.suppressed(f.rule, f.line):
+                continue
+            out.append(f)
+    out.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return out
+
+
+# --- baseline -----------------------------------------------------------------
+
+
+def load_baseline(path: Path) -> list[dict]:
+    if not Path(path).exists():
+        return []
+    data = json.loads(Path(path).read_text())
+    if not isinstance(data, dict) or "findings" not in data:
+        raise ValueError(f"{path} is not a graftlint baseline file")
+    return list(data["findings"])
+
+
+def write_baseline(path: Path, findings: Iterable[Finding]) -> None:
+    data = {
+        "version": 1,
+        "comment": (
+            "Grandfathered graftlint findings. Entries match by "
+            "(rule, path, source text) fingerprint, so they survive line "
+            "drift; fix the finding, then remove its entry (make "
+            "lint-baseline regenerates the file from the current tree)."
+        ),
+        "findings": [f.to_dict() for f in sorted(
+            findings, key=lambda f: (f.path, f.line, f.rule)
+        )],
+    }
+    Path(path).write_text(json.dumps(data, indent=2, sort_keys=False) + "\n")
+
+
+def apply_baseline(
+    findings: list[Finding], baseline: list[dict]
+) -> tuple[list[Finding], list[Finding], list[dict]]:
+    """Split findings into (new, grandfathered) and report stale entries.
+
+    Multiset semantics: a baseline entry absorbs at most one finding with
+    its fingerprint. Stale entries (nothing matched) are returned so the
+    CLI can nag — a fixed finding should lose its baseline row.
+    """
+    budget: dict[str, int] = {}
+    for entry in baseline:
+        fp = entry.get("fingerprint", "")
+        budget[fp] = budget.get(fp, 0) + 1
+    fresh: list[Finding] = []
+    grandfathered: list[Finding] = []
+    for f in findings:
+        fp = f.fingerprint()
+        if budget.get(fp, 0) > 0:
+            budget[fp] -= 1
+            grandfathered.append(f)
+        else:
+            fresh.append(f)
+    stale = []
+    for entry in baseline:
+        fp = entry.get("fingerprint", "")
+        if budget.get(fp, 0) > 0:
+            budget[fp] -= 1
+            stale.append(entry)
+    return fresh, grandfathered, stale
+
+
+# --- shared AST helpers (used by several rules) -------------------------------
+
+
+def dotted_name(node: ast.AST) -> str | None:
+    """`a.b.c` -> "a.b.c", `name` -> "name", else None."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def last_segment(node: ast.AST) -> str | None:
+    """The trailing identifier of a Name/Attribute/Call expression."""
+    if isinstance(node, ast.Call):
+        node = node.func
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def walk_with_stack(
+    tree: ast.AST,
+    visit: Callable[[ast.AST, tuple[ast.AST, ...]], None],
+) -> None:
+    """ast.walk with an ancestor stack (outermost first)."""
+
+    def rec(node: ast.AST, stack: tuple[ast.AST, ...]) -> None:
+        visit(node, stack)
+        for child in ast.iter_child_nodes(node):
+            rec(child, stack + (node,))
+
+    rec(tree, ())
+
+
+def docstring_linenos(tree: ast.Module) -> set[int]:
+    """Line spans of every docstring expression (module/class/function) —
+    rules that police string literals must not police documentation."""
+    spans: set[int] = set()
+    for node in ast.walk(tree):
+        if isinstance(
+            node, (ast.Module, ast.ClassDef, ast.FunctionDef, ast.AsyncFunctionDef)
+        ):
+            body = getattr(node, "body", [])
+            if body and isinstance(body[0], ast.Expr) and isinstance(
+                body[0].value, ast.Constant
+            ) and isinstance(body[0].value.value, str):
+                expr = body[0].value
+                spans.update(range(expr.lineno, (expr.end_lineno or expr.lineno) + 1))
+    return spans

@@ -1,0 +1,56 @@
+"""bare-jit fixture: three violating sites, three sanctioned ones."""
+import functools
+
+import jax
+
+from albedo_tpu.utils.aot import persistent_aot_executable
+
+
+def kernel(x):
+    return x * 2
+
+
+# BAD: decorated jit never reaches the AOT layer.
+@jax.jit
+def bad_decorated(x):
+    return x + 1
+
+
+# BAD: partial-jit decorator, also unfed.
+@functools.partial(jax.jit, static_argnames=("k",))
+def bad_partial(x, k):
+    return x * k
+
+
+def bad_call_site(x):
+    # BAD: jit result bound to a name nobody feeds to utils/aot.
+    jitted = jax.jit(kernel)
+    return jitted(x)
+
+
+# OK: decorated function fed to the AOT layer by name.
+@jax.jit
+def ok_decorated(x):
+    return x - 1
+
+
+def ok_acquire(x):
+    compiled, _, _ = persistent_aot_executable(
+        ok_decorated, (x,), None, None, ("fixture",), name="fixture"
+    )
+    return compiled(x)
+
+
+def ok_assignment_chain(x):
+    # OK: sanctioned through the assignment chain (fn -> jax.jit result).
+    fn = jax.jit(kernel)
+    compiled, _, _ = persistent_aot_executable(
+        fn, (x,), None, None, ("fixture2",), name="fixture2"
+    )
+    return compiled(x)
+
+
+def ok_pragma(x):
+    # Reference path, interactive use only.
+    jitted = jax.jit(kernel)  # albedo: noqa[bare-jit]
+    return jitted(x)

@@ -1,0 +1,22 @@
+"""Fixture CLI with the exit-code contract registry."""
+import sys
+
+EXIT_OK = 0
+EXIT_FAILURE = 1
+EXIT_PREEMPTED = 75
+
+
+def job_bare_literal():
+    return 75          # BAD: contract code inlined instead of EXIT_PREEMPTED
+
+
+def job_off_contract():
+    sys.exit(9)        # BAD: exit code outside the contract
+
+
+def job_ok():
+    return EXIT_FAILURE  # OK: the constant
+
+
+def job_pragma():
+    return 1  # albedo: noqa[contract-drift]

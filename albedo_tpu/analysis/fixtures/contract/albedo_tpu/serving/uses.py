@@ -1,0 +1,13 @@
+"""Fixture module exercising every metric/fault-site drift direction.
+
+Counters ride ``albedo_good_total`` (docstring mentions are documentation,
+never findings).
+"""
+from albedo_tpu.utils import faults
+
+DOCUMENTED = faults.site("good.site")
+UNDOCUMENTED = faults.site("undocumented.site")  # BAD: not in the catalog
+
+INLINE = "albedo_good_total"        # BAD: inline literal of a registered name
+TYPO = "albedo_ghost_total"         # BAD: *_total literal nobody registered
+NOT_A_METRIC = "albedo_tpu"         # OK: not a metric-shaped token

@@ -1,0 +1,4 @@
+"""Fixture metric registry (mirrors the real utils/events.py shape)."""
+
+GOOD_TOTAL = "albedo_good_total"
+UNDOCUMENTED_TOTAL = "albedo_undocumented_total"  # registered, absent from docs

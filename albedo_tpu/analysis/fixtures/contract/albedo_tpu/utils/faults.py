@@ -1,0 +1,6 @@
+"""Fixture faults harness stand-in (excluded from site scanning, like the
+real one)."""
+
+
+def site(name):
+    return name

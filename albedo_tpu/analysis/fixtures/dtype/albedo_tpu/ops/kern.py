@@ -1,0 +1,21 @@
+"""dtype-discipline fixture: bf16-capable contractions with/without f32."""
+import jax.numpy as jnp
+
+
+def bad_kernel(source, idx, c1, gather_dtype=None):
+    gathered = source.astype(jnp.bfloat16)[idx]
+    # BAD: bf16-capable function, contraction accumulates in operand dtype.
+    return jnp.einsum("blk,bl->bk", gathered, c1)
+
+
+def ok_kernel(source, idx, c1, gather_dtype=None):
+    gathered = source.astype(jnp.bfloat16)[idx]
+    # OK: explicit f32 accumulation.
+    return jnp.einsum(
+        "blk,bl->bk", gathered, c1, preferred_element_type=jnp.float32
+    )
+
+
+def ok_f32_only(a, b):
+    # OK: not bf16-capable — plain f32 helper, no discipline required.
+    return jnp.einsum("ij,jk->ik", a, b)

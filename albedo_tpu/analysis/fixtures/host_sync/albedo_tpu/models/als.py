@@ -1,0 +1,34 @@
+"""hidden-host-sync fixture: hot-root reachability + loop-borne syncs.
+
+The test configures the rule with roots=[("albedo_tpu/models/als.py",
+"Trainer.fit")]; ``helper`` is reachable through the call graph,
+``unreachable_prep`` is not.
+"""
+import numpy as np
+
+
+def helper(xs):
+    total = 0.0
+    for x in xs:
+        total += float(x)          # BAD: loop-borne float() in reachable code
+    return total
+
+
+def unreachable_prep(xs):
+    # OK: same syncs, but nothing reachable from the hot root calls this.
+    vals = [float(x) for x in xs]
+    return [np.asarray(v) for v in vals]
+
+
+class Trainer:
+    def fit(self, xs, loss):
+        acc = helper(xs)
+        out = []
+        for x in xs:
+            out.append(np.asarray(x))   # BAD: loop-borne d2h copy
+        host = loss.item()              # BAD: sync anywhere in reachable code
+        final = np.asarray(out[0])      # OK: conversion outside any loop
+        for x in xs:
+            # Materialized for the checkpoint callback, by contract.
+            out.append(np.asarray(x))   # albedo: noqa[hidden-host-sync]
+        return acc, host, final

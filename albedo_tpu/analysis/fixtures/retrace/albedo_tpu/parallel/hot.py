@@ -1,0 +1,52 @@
+"""retrace-hazard fixture: traced-value branches and unhashable statics."""
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def bad_branch(x, threshold):
+    # BAD: Python branch on a traced parameter.
+    if threshold > 0:
+        return x * threshold
+    return x
+
+
+@functools.partial(jax.jit, static_argnames=("mode",))
+def ok_static_branch(x, mode):
+    # OK: `mode` is a declared static.
+    if mode == "double":
+        return x * 2
+    return x
+
+
+@jax.jit
+def ok_shape_branch(x, y):
+    # OK: shape reads and identity checks are static under tracing.
+    if x.shape[0] > 4:
+        return x + 1
+    if y is None:
+        return x
+    return x + y
+
+
+@functools.partial(jax.jit, static_argnames=("opts",))
+def bad_unhashable_static(x, opts=[]):
+    # BAD: static argument with an unhashable (list) default.
+    return x + len(opts)
+
+
+def plain_helper(x, flag):
+    # OK: not jitted — Python branching is fine on the host.
+    if flag:
+        return x * 2
+    return x
+
+
+@jax.jit
+def ok_pragma_branch(x, n):
+    # n is always a concrete Python int at every call site (bounded fan-out).
+    if n > 2:  # albedo: noqa[retrace-hazard]
+        return x * n
+    return jnp.sin(x)

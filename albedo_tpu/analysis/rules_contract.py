@@ -1,0 +1,378 @@
+"""R3 ``contract-drift`` — catalogs that must match code, both directions.
+
+Three contracts, one rule id (findings name the sub-contract):
+
+- **Fault sites**: every ``faults.site("...")`` declared in code must be a
+  row of the ARCHITECTURE.md site-catalog table, and every row must name a
+  site that still exists (the generalized ``tests/test_fault_sites.py``,
+  which now calls into this module — one implementation).
+- **Metric names**: ``utils/events.py`` is the single registry of
+  ``albedo_*`` metric names. Code outside it must use the constants, not
+  inline literals; ARCHITECTURE.md's metrics catalog must list every
+  registered name; a ``*_total`` token nobody registered is drift.
+- **Exit codes**: the process exit-code contract lives as ``EXIT_*``
+  constants in ``cli.py``. The job modules must return the constants (not
+  bare ints), docs may only mention contract codes, and the ARCHITECTURE.md
+  exit-code table must cover the whole contract.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator
+
+from albedo_tpu.analysis.core import (
+    Finding,
+    ProjectTree,
+    Rule,
+    docstring_linenos,
+    dotted_name,
+    last_segment,
+    register,
+)
+
+# --- fault sites --------------------------------------------------------------
+
+_SITE_FUNCS = {"site", "hit", "arm"}
+_CATALOG_NAME = re.compile(r"`([a-z_.<>]+)`")
+_FAULTS_MODULE = "albedo_tpu/utils/faults.py"
+
+
+def _normalize_site(raw: str, is_fstring: bool) -> str:
+    if is_fstring:
+        return re.sub(r"\{[^}]*\}", "<name>", raw)
+    return raw
+
+
+def fault_sites_in_code(tree: ProjectTree) -> dict[str, tuple[str, int]]:
+    """site name -> (module, line) for every declared/armed fault site.
+
+    Handles literal and f-string forms (``{expr}`` interpolations normalize
+    to ``<name>``); only dotted lowercase names count — that keeps unrelated
+    ``site()``/``hit()`` call patterns out, same contract as the original
+    bespoke lint.
+    """
+    found: dict[str, tuple[str, int]] = {}
+    for rel, mod in tree.modules.items():
+        if rel == _FAULTS_MODULE:
+            continue  # the harness itself (docstrings + generic helpers)
+        for node in ast.walk(mod.tree):
+            if not (isinstance(node, ast.Call) and node.args):
+                continue
+            if last_segment(node.func) not in _SITE_FUNCS:
+                continue
+            arg = node.args[0]
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                site = _normalize_site(arg.value, False)
+            elif isinstance(arg, ast.JoinedStr):
+                parts = []
+                for piece in arg.values:
+                    if isinstance(piece, ast.Constant):
+                        parts.append(str(piece.value))
+                    else:
+                        parts.append("{}")
+                site = _normalize_site("".join(parts).replace("{}", "<name>"), False)
+            else:
+                continue
+            if "." in site and site == site.lower():
+                found.setdefault(site, (rel, node.lineno))
+    return found
+
+
+def fault_sites_in_catalog(tree: ProjectTree) -> set[str]:
+    """Backticked dotted names in the first cell of catalog table rows."""
+    sites: set[str] = set()
+    text = tree.docs.get("ARCHITECTURE.md", "")
+    for line in text.splitlines():
+        if not line.startswith("|"):
+            continue
+        first_cell = line.split("|")[1]
+        for m in _CATALOG_NAME.finditer(first_cell):
+            if "." in m.group(1):
+                sites.add(m.group(1))
+    return sites
+
+
+# --- metric names -------------------------------------------------------------
+
+_EVENTS_MODULE = "albedo_tpu/utils/events.py"
+_METRIC_TOKEN = re.compile(r"\balbedo_[a-z0-9_]+\b")
+# Histogram expositions suffix the base name; strip before registry lookup.
+_SERIES_SUFFIXES = ("_bucket", "_sum", "_count")
+
+
+def metric_registry(tree: ProjectTree) -> dict[str, tuple[str, int]]:
+    """UPPER_CASE string constants in utils/events.py: name -> (const, line)."""
+    registry: dict[str, tuple[str, int]] = {}
+    mod = tree.get(_EVENTS_MODULE)
+    if mod is None:
+        return registry
+    for node in mod.tree.body:
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1):
+            continue
+        tgt = node.targets[0]
+        if not (isinstance(tgt, ast.Name) and tgt.id.isupper()):
+            continue
+        if isinstance(node.value, ast.Constant) and isinstance(node.value.value, str):
+            if node.value.value.startswith("albedo_"):
+                registry[node.value.value] = (tgt.id, node.lineno)
+    return registry
+
+
+def _base_metric(token: str, registry: dict) -> str:
+    if token in registry:
+        return token
+    for suf in _SERIES_SUFFIXES:
+        if token.endswith(suf) and token[: -len(suf)] in registry:
+            return token[: -len(suf)]
+    return token
+
+
+# --- exit codes ---------------------------------------------------------------
+
+_CLI_MODULE = "albedo_tpu/cli.py"
+# Modules whose integer returns ARE process exit codes (jobs + the faults
+# harness's os._exit). serving's HTTP-status returns are a different plane.
+_EXIT_CONTRACT_MODULES = (
+    "albedo_tpu/cli.py",
+    "albedo_tpu/builders/pipeline.py",
+    "albedo_tpu/builders/jobs.py",
+    "albedo_tpu/streaming/job.py",
+    "albedo_tpu/utils/faults.py",
+)
+# "exit 75" / "exits 75" / "exit code 4" — but NOT duration/count prose like
+# "exits 30 s after SIGTERM" or "exited 20 cycles in" (unit word after the
+# number means it is not an exit code).
+_DOC_EXIT = re.compile(
+    r"\bexit(?:s|ed)?\s*(?:code\s*)?(\d{1,3})\b"
+    r"(?!\s*(?:s|ms|sec|secs|seconds|min|mins|minutes|h|hours|%|x|times|"
+    r"cycles|iterations|rows|steps)\b)",
+    re.IGNORECASE,
+)
+
+
+def exit_code_registry(tree: ProjectTree) -> dict[int, tuple[str, int]]:
+    """``EXIT_* = <int>`` assignments in cli.py: value -> (name, line)."""
+    registry: dict[int, tuple[str, int]] = {}
+    mod = tree.get(_CLI_MODULE)
+    if mod is None:
+        return registry
+    for node in mod.tree.body:
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1):
+            continue
+        tgt = node.targets[0]
+        if not (isinstance(tgt, ast.Name) and tgt.id.startswith("EXIT_")):
+            continue
+        if isinstance(node.value, ast.Constant) and isinstance(node.value.value, int):
+            registry[node.value.value] = (tgt.id, node.lineno)
+    return registry
+
+
+def _doc_exit_table_codes(text: str) -> set[int] | None:
+    """Codes from the markdown table under the exit-code heading, or None
+    when no such section exists."""
+    lines = text.splitlines()
+    in_section = False
+    codes: set[int] = set()
+    seen_table = False
+    for line in lines:
+        if line.startswith("#") and "exit" in line.lower() and "code" in line.lower():
+            in_section = True
+            continue
+        if in_section and line.startswith("#"):
+            break
+        if in_section and line.startswith("|"):
+            cell = line.split("|")[1].strip().strip("`")
+            if cell.isdigit():
+                codes.add(int(cell))
+                seen_table = True
+    return codes if seen_table else None
+
+
+@register
+class ContractDrift(Rule):
+    id = "contract-drift"
+    summary = (
+        "fault-site catalog, metric-name registry, and exit-code contract "
+        "checked both directions against code and docs"
+    )
+
+    def check(self, tree: ProjectTree) -> Iterator[Finding]:
+        yield from self._check_fault_sites(tree)
+        yield from self._check_metrics(tree)
+        yield from self._check_exit_codes(tree)
+
+    # ------------------------------------------------------------ fault sites
+    def _check_fault_sites(self, tree: ProjectTree) -> Iterator[Finding]:
+        if "ARCHITECTURE.md" not in tree.docs:
+            return
+        code = fault_sites_in_code(tree)
+        catalog = fault_sites_in_catalog(tree)
+        for site in sorted(set(code) - catalog):
+            rel, line = code[site]
+            yield Finding(
+                self.id, rel, line, 0,
+                f"fault site `{site}` is not in the ARCHITECTURE.md site "
+                f"catalog — undocumented sites are invisible to operators "
+                f"writing ALBEDO_FAULTS drills",
+                tree.modules[rel].line_text(line),
+            )
+        for site in sorted(catalog - set(code)):
+            yield Finding(
+                self.id, "ARCHITECTURE.md", 0, 0,
+                f"ARCHITECTURE.md catalogs fault site `{site}` but no code "
+                f"declares it — the drill it documents can never fire",
+            )
+
+    # -------------------------------------------------------------- metrics
+    def _check_metrics(self, tree: ProjectTree) -> Iterator[Finding]:
+        registry = metric_registry(tree)
+        if not registry:
+            return
+        # Code side: inline literals outside the registry module.
+        for rel, mod in tree.modules.items():
+            if rel == _EVENTS_MODULE:
+                continue
+            doc_lines = docstring_linenos(mod.tree)
+            for node in ast.walk(mod.tree):
+                if not (
+                    isinstance(node, ast.Constant) and isinstance(node.value, str)
+                ):
+                    continue
+                if node.lineno in doc_lines:
+                    continue  # documentation, not duplication
+                token = node.value
+                if token in registry:
+                    yield Finding(
+                        self.id, rel, node.lineno, node.col_offset,
+                        f"inline metric name {token!r} — import the "
+                        f"`utils.events.{registry[token][0]}` constant "
+                        f"instead (one registry, zero drift)",
+                        mod.line_text(node.lineno),
+                    )
+                elif _METRIC_TOKEN.fullmatch(token) and token.endswith("_total"):
+                    yield Finding(
+                        self.id, rel, node.lineno, node.col_offset,
+                        f"metric name {token!r} is not registered in "
+                        f"utils/events.py — register it (or fix the typo)",
+                        mod.line_text(node.lineno),
+                    )
+        # Docs side, both directions.
+        arch = tree.docs.get("ARCHITECTURE.md")
+        if arch is not None:
+            doc_tokens = set(_METRIC_TOKEN.findall(arch))
+            for token in sorted(doc_tokens):
+                base = _base_metric(token, registry)
+                if base not in registry and token.endswith("_total"):
+                    yield Finding(
+                        self.id, "ARCHITECTURE.md", 0, 0,
+                        f"ARCHITECTURE.md mentions metric `{token}` but "
+                        f"utils/events.py does not register it",
+                    )
+            for name in sorted(registry):
+                if name not in doc_tokens:
+                    yield Finding(
+                        self.id, _EVENTS_MODULE, registry[name][1], 0,
+                        f"registered metric `{name}` is missing from the "
+                        f"ARCHITECTURE.md metrics catalog",
+                        tree.modules[_EVENTS_MODULE].line_text(registry[name][1]),
+                    )
+        readme = tree.docs.get("README.md")
+        if readme is not None:
+            for token in sorted(set(_METRIC_TOKEN.findall(readme))):
+                base = _base_metric(token, registry)
+                if base not in registry and token.endswith("_total"):
+                    yield Finding(
+                        self.id, "README.md", 0, 0,
+                        f"README.md mentions metric `{token}` but "
+                        f"utils/events.py does not register it",
+                    )
+
+    # ----------------------------------------------------------- exit codes
+    def _check_exit_codes(self, tree: ProjectTree) -> Iterator[Finding]:
+        registry = exit_code_registry(tree)
+        if not registry:
+            return
+        contract = set(registry)
+        # Code side: bare int literals where an EXIT_* constant belongs.
+        for rel in _EXIT_CONTRACT_MODULES:
+            mod = tree.get(rel)
+            if mod is None:
+                continue
+            for node in ast.walk(mod.tree):
+                lit: ast.Constant | None = None
+                context = ""
+                if (
+                    isinstance(node, ast.Return)
+                    and isinstance(node.value, ast.Constant)
+                    and isinstance(node.value.value, int)
+                    and not isinstance(node.value.value, bool)
+                    and node.value.value != 0
+                ):
+                    lit, context = node.value, "return"
+                elif isinstance(node, ast.Call) and dotted_name(node.func) in (
+                    "sys.exit", "os._exit"
+                ):
+                    if node.args and isinstance(node.args[0], ast.Constant) and (
+                        isinstance(node.args[0].value, int)
+                    ):
+                        lit, context = node.args[0], dotted_name(node.func)
+                if lit is None:
+                    continue
+                val = int(lit.value)
+                if val in contract:
+                    yield Finding(
+                        self.id, rel, lit.lineno, lit.col_offset,
+                        f"bare exit code {val} in {context} — use "
+                        f"`cli.{registry[val][0]}` so the contract has one "
+                        f"definition",
+                        mod.line_text(lit.lineno),
+                    )
+                else:
+                    yield Finding(
+                        self.id, rel, lit.lineno, lit.col_offset,
+                        f"exit code {val} is outside the contract "
+                        f"({sorted(contract)}) — extend cli.py's EXIT_* "
+                        f"registry or fix the code",
+                        mod.line_text(lit.lineno),
+                    )
+        # Docs side: mentioned codes must be contract members...
+        for doc_name in ("ARCHITECTURE.md", "README.md"):
+            text = tree.docs.get(doc_name)
+            if text is None:
+                continue
+            for m in _DOC_EXIT.finditer(text):
+                val = int(m.group(1))
+                if val not in contract:
+                    line = text.count("\n", 0, m.start()) + 1
+                    yield Finding(
+                        self.id, doc_name, line, 0,
+                        f"{doc_name} documents exit code {val}, which is "
+                        f"outside the contract ({sorted(contract)})",
+                    )
+        # ...and the ARCHITECTURE table must cover the whole contract.
+        arch = tree.docs.get("ARCHITECTURE.md")
+        if arch is not None:
+            table = _doc_exit_table_codes(arch)
+            if table is None:
+                yield Finding(
+                    self.id, "ARCHITECTURE.md", 0, 0,
+                    "ARCHITECTURE.md has no exit-code contract table "
+                    "(a heading mentioning 'exit code' followed by a "
+                    "markdown table, one row per code)",
+                )
+            else:
+                for val in sorted(contract - table):
+                    yield Finding(
+                        self.id, _CLI_MODULE, registry[val][1], 0,
+                        f"exit code {val} ({registry[val][0]}) is missing "
+                        f"from the ARCHITECTURE.md exit-code table",
+                        tree.modules[_CLI_MODULE].line_text(registry[val][1]),
+                    )
+                for val in sorted(table - contract):
+                    yield Finding(
+                        self.id, "ARCHITECTURE.md", 0, 0,
+                        f"the ARCHITECTURE.md exit-code table lists {val}, "
+                        f"which cli.py's EXIT_* registry does not define",
+                    )

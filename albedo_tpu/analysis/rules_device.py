@@ -1,0 +1,322 @@
+"""R1 ``bare-jit`` and R2 ``hidden-host-sync`` — the device-discipline rules.
+
+R1: every executable on a hot path must be acquired through the persistent
+AOT layer (``utils/aot.py``) — bare ``jax.jit``/``pjit`` call sites ride the
+persistent XLA cache unguarded, which is exactly the PR 4 kill-resume
+corruption (custom-call programs deserializing to nondeterministically wrong
+numerics). A jit site is *sanctioned* when the jitted object provably flows
+into ``persistent_aot_executable``/``persistent_aot_call``: directly as the
+first argument, via an assignment chain (``fn = _gather_topk``;
+``self._update = make_sharded_update(...)``), by being built inside a
+function whose result is fed (``_foldin_solve()``), or through a conduit
+wrapper that forwards its parameter (``_aot_call(jitted, ...)``).
+Intentional exceptions carry a ``# albedo: noqa[bare-jit]`` pragma with the
+reason — the pragma IS the documentation.
+
+R2: no hidden host<->device synchronization inside functions reachable from
+the fit / fold-in / batcher hot loops. ``.item()`` / ``.tolist()`` /
+``.block_until_ready()`` flag anywhere in the reachable set;
+``float(x)`` / ``np.asarray(x)`` / ``np.array(x)`` flag only inside loops —
+the shape of the PR 6 fold-in regression (a per-chunk host round trip that
+cost 30x until removed). ``utils/watchdog.py`` is allowlisted wholesale: its
+fused health reduction's single d2h read IS the designed completion barrier.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from albedo_tpu.analysis.callgraph import CallGraph, FunctionInfo
+from albedo_tpu.analysis.core import (
+    Finding,
+    ProjectTree,
+    Rule,
+    dotted_name,
+    last_segment,
+    register,
+    walk_with_stack,
+)
+
+# Packages whose jit sites R1 polices (the device-code surface).
+DEVICE_PACKAGES = (
+    "albedo_tpu/models/",
+    "albedo_tpu/ops/",
+    "albedo_tpu/parallel/",
+    "albedo_tpu/serving/",
+    "albedo_tpu/streaming/",
+)
+
+_AOT_ENTRYPOINTS = {"persistent_aot_executable", "persistent_aot_call"}
+_PARTIAL_NAMES = {"partial", "functools.partial"}
+
+
+def _is_jit_expr(node: ast.AST, jit_aliases: set[str]) -> bool:
+    dn = dotted_name(node)
+    if dn is None:
+        return False
+    if dn in ("jax.jit", "pjit") or dn.endswith(".pjit"):
+        return True
+    return dn in jit_aliases
+
+
+def _jit_aliases(mod_tree: ast.Module) -> set[str]:
+    """Local names bound to jax.jit/pjit via `from jax import jit [as j]`."""
+    aliases: set[str] = set()
+    for node in ast.walk(mod_tree):
+        if isinstance(node, ast.ImportFrom) and node.module:
+            if node.module == "jax" or node.module.endswith("pjit"):
+                for alias in node.names:
+                    if alias.name in ("jit", "pjit"):
+                        aliases.add(alias.asname or alias.name)
+    return aliases
+
+
+def _fed_names(tree: ProjectTree) -> set[str]:
+    """Every identifier that (transitively) feeds the AOT layer's first
+    argument, package-wide."""
+    extract = last_segment  # Name/Attribute/Call -> trailing identifier
+
+    # Pass 1: conduit wrappers — functions that forward one of their own
+    # parameters into persistent_aot_* (e.g. logistic_regression._aot_call).
+    conduits: dict[str, int] = {}
+    for mod in tree.modules.values():
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            params = [a.arg for a in node.args.args]
+            for call in ast.walk(node):
+                if (
+                    isinstance(call, ast.Call)
+                    and last_segment(call.func) in _AOT_ENTRYPOINTS
+                    and call.args
+                    and isinstance(call.args[0], ast.Name)
+                    and call.args[0].id in params
+                ):
+                    conduits[node.name] = params.index(call.args[0].id)
+
+    # Pass 2: direct feeds (including through conduits), tracked as
+    # (module, name) so the backward propagation below cannot leak across
+    # files through a collision on a generic local name like `fn`.
+    fed: set[tuple[str, str]] = set()
+    for rel, mod in tree.modules.items():
+        for call in ast.walk(mod.tree):
+            if not isinstance(call, ast.Call):
+                continue
+            callee = last_segment(call.func)
+            arg: ast.AST | None = None
+            if callee in _AOT_ENTRYPOINTS and call.args:
+                arg = call.args[0]
+            elif callee in conduits and len(call.args) > conduits[callee]:
+                arg = call.args[conduits[callee]]
+            if arg is not None:
+                name = extract(arg)
+                if name:
+                    fed.add((rel, name))
+                if isinstance(arg, ast.Call):
+                    inner = extract(arg.func)
+                    if inner:
+                        fed.add((rel, inner))
+
+    # Pass 3: propagate backwards through simple assignments WITHIN a module
+    # (`fn = _gather_topk`, `self._update = make_sharded_update(...)`).
+    assignments: list[tuple[str, str, str]] = []  # (module, target, source)
+    for rel, mod in tree.modules.items():
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                tgt = extract(node.targets[0])
+                src = extract(node.value)
+                if tgt and src and tgt != src:
+                    assignments.append((rel, tgt, src))
+    for _ in range(10):  # fixpoint; chains in this repo are depth <= 3
+        added = False
+        for rel, tgt, src in assignments:
+            if (rel, tgt) in fed and (rel, src) not in fed:
+                fed.add((rel, src))
+                added = True
+        if not added:
+            break
+    # Sanctioning is by bare name: a decorated kernel defined in ops/ is fed
+    # by models/ (cross-module def references resolve by identifier).
+    return {name for _rel, name in fed}
+
+
+@register
+class BareJit(Rule):
+    id = "bare-jit"
+    summary = (
+        "jax.jit/pjit in device packages bypassing the utils/aot.py "
+        "persistent-executable layer"
+    )
+
+    def check(self, tree: ProjectTree) -> Iterator[Finding]:
+        fed = _fed_names(tree)
+        for mod in tree.in_packages(*DEVICE_PACKAGES):
+            aliases = _jit_aliases(mod.tree)
+            findings: list[Finding] = []
+
+            def visit(node: ast.AST, stack: tuple[ast.AST, ...]) -> None:
+                enclosing = [
+                    n.name for n in stack
+                    if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+                ]
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    for deco in node.decorator_list:
+                        jitted = _is_jit_expr(deco, aliases) or (
+                            isinstance(deco, ast.Call)
+                            and (
+                                _is_jit_expr(deco.func, aliases)
+                                or (
+                                    dotted_name(deco.func) in _PARTIAL_NAMES
+                                    and deco.args
+                                    and _is_jit_expr(deco.args[0], aliases)
+                                )
+                            )
+                        )
+                        if jitted and node.name not in fed and not any(
+                            n in fed for n in enclosing
+                        ):
+                            findings.append(Finding(
+                                self.id, mod.path, deco.lineno, deco.col_offset,
+                                f"`{node.name}` is jitted here but never "
+                                f"acquired through utils/aot.py "
+                                f"(persistent_aot_executable/_call) — bare "
+                                f"executables ride the XLA cache unguarded "
+                                f"(the PR 4 kill-resume corruption class)",
+                                mod.line_text(deco.lineno),
+                            ))
+                elif isinstance(node, ast.Call) and _is_jit_expr(node.func, aliases):
+                    sanctioned = set(enclosing) & fed
+                    assign = next(
+                        (
+                            n for n in reversed(stack)
+                            if isinstance(n, ast.Assign) and n.value is node
+                        ),
+                        None,
+                    )
+                    if assign is not None:
+                        for tgt in assign.targets:
+                            name = last_segment(tgt)
+                            if name and name in fed:
+                                sanctioned.add(name)
+                    if not sanctioned:
+                        bound = (
+                            last_segment(assign.targets[0])
+                            if assign is not None and assign.targets else None
+                        )
+                        what = f"`{bound}`" if bound else "the jitted callable"
+                        findings.append(Finding(
+                            self.id, mod.path, node.lineno, node.col_offset,
+                            f"bare jit call: {what} never reaches "
+                            f"utils/aot.py (persistent_aot_executable/_call)",
+                            mod.line_text(node.lineno),
+                        ))
+
+            walk_with_stack(mod.tree, visit)
+            yield from findings
+
+
+# Hot-loop roots: the training fit (resident/chunked/sharded), the LR fit,
+# the streaming fold-in, and the serving micro-batcher worker.
+DEFAULT_HOT_ROOTS: tuple[tuple[str, str], ...] = (
+    ("albedo_tpu/models/als.py", "ImplicitALS.fit"),
+    ("albedo_tpu/models/als.py", "ImplicitALS._fit_chunked"),
+    ("albedo_tpu/models/als.py", "ImplicitALS._fit_sharded"),
+    ("albedo_tpu/models/logistic_regression.py", "LogisticRegression.fit"),
+    ("albedo_tpu/parallel/als.py", "ShardedALSFit.fit"),
+    ("albedo_tpu/streaming/foldin.py", "FoldInEngine.fold_in"),
+    ("albedo_tpu/serving/batcher.py", "MicroBatcher._run"),
+)
+
+# watchdog: its fused health reduction's single d2h read IS the designed
+# completion barrier. aot: the probe-fingerprint readback runs once at
+# executable-acquisition time, not per hot-loop iteration.
+DEFAULT_ALLOW_MODULES = (
+    "albedo_tpu/utils/watchdog.py",
+    "albedo_tpu/utils/aot.py",
+)
+
+_SYNC_METHODS = {"item", "tolist", "block_until_ready"}
+_LOOP_CONVERTERS = {"float"}
+_NP_READBACKS = {"np.asarray", "np.array", "numpy.asarray", "numpy.array"}
+_LOOP_NODES = (
+    ast.For, ast.AsyncFor, ast.While,
+    ast.ListComp, ast.SetComp, ast.DictComp, ast.GeneratorExp,
+)
+
+
+@register
+class HiddenHostSync(Rule):
+    id = "hidden-host-sync"
+    summary = (
+        "host<->device synchronization inside functions reachable from the "
+        "fit/fold-in/batcher hot loops"
+    )
+
+    def __init__(
+        self,
+        roots: tuple[tuple[str, str], ...] = DEFAULT_HOT_ROOTS,
+        allow_modules: tuple[str, ...] = DEFAULT_ALLOW_MODULES,
+    ):
+        self.roots = roots
+        self.allow_modules = allow_modules
+
+    def check(self, tree: ProjectTree) -> Iterator[Finding]:
+        graph = CallGraph(tree)
+        reachable = graph.reachable(list(self.roots), self.allow_modules)
+        for fn in reachable:
+            if fn.module in self.allow_modules:
+                continue
+            yield from self._check_function(tree, fn)
+
+    def _check_function(
+        self, tree: ProjectTree, fn: FunctionInfo
+    ) -> Iterator[Finding]:
+        mod = tree.get(fn.module)
+        assert mod is not None
+        findings: list[Finding] = []
+
+        def visit(node: ast.AST, stack: tuple[ast.AST, ...]) -> None:
+            if not isinstance(node, ast.Call):
+                return
+            in_loop = any(isinstance(n, _LOOP_NODES) for n in stack)
+            func = node.func
+            if (
+                isinstance(func, ast.Attribute)
+                and func.attr in _SYNC_METHODS
+                and not node.args
+            ):
+                findings.append(Finding(
+                    self.id, fn.module, node.lineno, node.col_offset,
+                    f"`.{func.attr}()` inside `{fn.qualname}`, reachable "
+                    f"from a hot loop — a device sync here stalls every "
+                    f"iteration (PR 6 class; the watchdog's fused health "
+                    f"read is the sanctioned barrier)",
+                    mod.line_text(node.lineno),
+                ))
+            elif in_loop:
+                dn = dotted_name(func)
+                if (
+                    isinstance(func, ast.Name)
+                    and func.id in _LOOP_CONVERTERS
+                    and len(node.args) == 1
+                    and not isinstance(node.args[0], ast.Constant)
+                ):
+                    findings.append(Finding(
+                        self.id, fn.module, node.lineno, node.col_offset,
+                        f"loop-borne `{func.id}()` in `{fn.qualname}` — a "
+                        f"host conversion of a device value inside a hot "
+                        f"loop is a per-iteration d2h round trip",
+                        mod.line_text(node.lineno),
+                    ))
+                elif dn in _NP_READBACKS:
+                    findings.append(Finding(
+                        self.id, fn.module, node.lineno, node.col_offset,
+                        f"loop-borne `{dn}()` in `{fn.qualname}` — if the "
+                        f"operand lives on device this is a per-iteration "
+                        f"d2h copy (the 0.09s->0.003s PR 6 fold-in bug)",
+                        mod.line_text(node.lineno),
+                    ))
+
+        walk_with_stack(fn.node, visit)
+        yield from findings

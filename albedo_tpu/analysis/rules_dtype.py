@@ -1,0 +1,81 @@
+"""R4 ``dtype-discipline`` — bf16 gathers must accumulate in float32.
+
+The PR 1 rule from ``ops/als.py``: under ``gather_dtype="bfloat16"`` the
+huge gathered ``(B, L, k)`` blocks live in bf16 to halve streamed bytes,
+but every contraction over them must pin ``preferred_element_type=
+jnp.float32`` — the MXU's bf16-in/f32-out mode. A contraction that omits it
+accumulates in bf16 (~8 significant bits), which corrupted the b-vector
+weights by ~0.4% relative error per entry before the fix (ADVICE r5 #3).
+
+Statically: inside any *bf16-capable* function (one that takes a
+``gather_dtype`` parameter, receives a ``gathered`` block, or mentions
+bfloat16), every ``jnp.einsum`` / ``jnp.dot`` / ``jnp.matmul`` /
+``jnp.tensordot`` call must carry an explicit ``preferred_element_type``.
+f32-only helpers never trip the rule — their inputs cannot be bf16.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from albedo_tpu.analysis.core import (
+    Finding,
+    ProjectTree,
+    Rule,
+    dotted_name,
+    register,
+)
+from albedo_tpu.analysis.rules_device import DEVICE_PACKAGES
+
+_CONTRACTIONS = {"einsum", "dot", "matmul", "tensordot"}
+_CAPABLE_PARAMS = {"gather_dtype", "gathered"}
+
+
+def _bf16_capable(fn: ast.AST, source_segment: str) -> bool:
+    args = getattr(fn, "args", None)
+    if args is not None:
+        names = {a.arg for a in args.args + args.kwonlyargs}
+        if names & _CAPABLE_PARAMS:
+            return True
+    return "bfloat16" in source_segment
+
+
+@register
+class DtypeDiscipline(Rule):
+    id = "dtype-discipline"
+    summary = (
+        "bf16-capable kernels whose contractions lack an explicit f32 "
+        "accumulation (preferred_element_type)"
+    )
+
+    def check(self, tree: ProjectTree) -> Iterator[Finding]:
+        for mod in tree.in_packages(*DEVICE_PACKAGES):
+            for node in ast.walk(mod.tree):
+                if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue
+                segment = "\n".join(
+                    mod.lines[node.lineno - 1 : (node.end_lineno or node.lineno)]
+                )
+                if not _bf16_capable(node, segment):
+                    continue
+                for call in ast.walk(node):
+                    if not isinstance(call, ast.Call):
+                        continue
+                    dn = dotted_name(call.func)
+                    if dn is None:
+                        continue
+                    parts = dn.split(".")
+                    if parts[-1] not in _CONTRACTIONS or len(parts) < 2:
+                        continue
+                    kw = {k.arg for k in call.keywords}
+                    if "preferred_element_type" not in kw:
+                        yield Finding(
+                            self.id, mod.path, call.lineno, call.col_offset,
+                            f"`{dn}` inside bf16-capable `{node.name}` has "
+                            f"no preferred_element_type — a bf16 gather "
+                            f"feeding this contraction would accumulate in "
+                            f"bf16 (~8 significant bits; the ops/als.py "
+                            f"b-vector rule from PR 1)",
+                            mod.line_text(call.lineno),
+                        )

@@ -1,0 +1,172 @@
+"""R5 ``retrace-hazard`` — jitted functions with trace-unfriendly Python.
+
+Two hazard classes on directly-jitted functions (``@jax.jit``,
+``@functools.partial(jax.jit, ...)``, or ``jax.jit(f, ...)`` resolved in the
+same module):
+
+1. A Python-level ``if``/``while`` whose test reads a *traced* parameter.
+   Either it crashes at trace time (TracerBoolConversionError — found only
+   when an expensive TPU run reaches it), or the parameter arrives as a
+   Python scalar and the branch silently forks one compiled program per
+   value. Reading ``.shape``/``.ndim``/``.dtype``/``.size`` is fine (static
+   under tracing), as are ``is None`` / ``is not None`` identity checks
+   (tracers are never None) and parameters named in ``static_argnames``/
+   ``static_argnums``.
+
+2. A static-marked parameter whose default is an unhashable literal
+   (list/dict/set) — jit keys its cache on static hashes, so the first call
+   relying on the default dies with an unhashable-type error, typically in
+   whichever rarely-taken path nobody smoke-tested.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from albedo_tpu.analysis.core import (
+    Finding,
+    ProjectTree,
+    Rule,
+    dotted_name,
+    register,
+)
+from albedo_tpu.analysis.rules_device import DEVICE_PACKAGES, _is_jit_expr, _jit_aliases
+
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size"}
+_PARTIAL_NAMES = {"partial", "functools.partial"}
+
+
+def _static_names_from_call(call: ast.Call, fn: ast.FunctionDef) -> set[str]:
+    """Resolve static_argnames/static_argnums keywords to parameter names."""
+    params = [a.arg for a in fn.args.args]
+    out: set[str] = set()
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            for node in ast.walk(kw.value):
+                if isinstance(node, ast.Constant) and isinstance(node.value, str):
+                    out.add(node.value)
+        elif kw.arg == "static_argnums":
+            for node in ast.walk(kw.value):
+                if isinstance(node, ast.Constant) and isinstance(node.value, int):
+                    if 0 <= node.value < len(params):
+                        out.add(params[node.value])
+        elif kw.arg in ("donate_argnames", "donate_argnums"):
+            continue
+    return out
+
+
+def _jitted_functions(
+    mod_tree: ast.Module, aliases: set[str]
+) -> Iterator[tuple[ast.FunctionDef, set[str], ast.AST]]:
+    """(function def, static param names, jit site node) for every function
+    the module jits directly — via decorator or a same-module jax.jit(f)."""
+    defs: dict[str, ast.FunctionDef] = {}
+    for node in ast.walk(mod_tree):
+        if isinstance(node, ast.FunctionDef):
+            defs.setdefault(node.name, node)
+            for deco in node.decorator_list:
+                if _is_jit_expr(deco, aliases):
+                    yield node, set(), deco
+                elif isinstance(deco, ast.Call):
+                    if _is_jit_expr(deco.func, aliases):
+                        yield node, _static_names_from_call(deco, node), deco
+                    elif (
+                        dotted_name(deco.func) in _PARTIAL_NAMES
+                        and deco.args
+                        and _is_jit_expr(deco.args[0], aliases)
+                    ):
+                        yield node, _static_names_from_call(deco, node), deco
+    for node in ast.walk(mod_tree):
+        if (
+            isinstance(node, ast.Call)
+            and _is_jit_expr(node.func, aliases)
+            and node.args
+            and isinstance(node.args[0], ast.Name)
+            and node.args[0].id in defs
+        ):
+            fn = defs[node.args[0].id]
+            yield fn, _static_names_from_call(node, fn), node
+
+
+def _is_identity_test(test: ast.AST) -> bool:
+    return isinstance(test, ast.Compare) and all(
+        isinstance(op, (ast.Is, ast.IsNot)) for op in test.ops
+    )
+
+
+def _traced_reads(test: ast.AST, traced: set[str]) -> Iterator[ast.Name]:
+    """Name nodes in a branch test that read traced parameters directly
+    (not through a static attribute like ``.shape``)."""
+    parents: dict[ast.AST, ast.AST] = {}
+    for node in ast.walk(test):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+    for node in ast.walk(test):
+        if not (isinstance(node, ast.Name) and node.id in traced):
+            continue
+        parent = parents.get(node)
+        if (
+            isinstance(parent, ast.Attribute)
+            and parent.value is node
+            and parent.attr in _STATIC_ATTRS
+        ):
+            continue
+        # `x is None` style identity checks are static.
+        comp = node
+        while comp in parents and not isinstance(parents[comp], ast.Compare):
+            comp = parents[comp]
+        if comp in parents and _is_identity_test(parents[comp]):
+            continue
+        yield node
+
+
+@register
+class RetraceHazard(Rule):
+    id = "retrace-hazard"
+    summary = (
+        "jitted/shard_mapped functions whose Python branches read traced "
+        "values or whose statics default to unhashables"
+    )
+
+    def check(self, tree: ProjectTree) -> Iterator[Finding]:
+        for mod in tree.in_packages(*DEVICE_PACKAGES):
+            aliases = _jit_aliases(mod.tree)
+            seen: set[int] = set()
+            for fn, statics, _site in _jitted_functions(mod.tree, aliases):
+                if id(fn) in seen:
+                    continue
+                seen.add(id(fn))
+                params = {a.arg for a in fn.args.args + fn.args.kwonlyargs}
+                traced = params - statics - {"self"}
+                # Hazard 2: unhashable static defaults.
+                pos = fn.args.args
+                defaults = fn.args.defaults
+                for arg, default in zip(pos[len(pos) - len(defaults):], defaults):
+                    if arg.arg in statics and isinstance(
+                        default, (ast.List, ast.Dict, ast.Set)
+                    ):
+                        yield Finding(
+                            self.id, mod.path, default.lineno, default.col_offset,
+                            f"static argument `{arg.arg}` of jitted "
+                            f"`{fn.name}` defaults to an unhashable literal "
+                            f"— jit hashes statics into its cache key, so "
+                            f"the default-taking call path crashes",
+                            mod.line_text(default.lineno),
+                        )
+                # Hazard 1: branches on traced parameters.
+                for node in ast.walk(fn):
+                    if not isinstance(node, (ast.If, ast.While)):
+                        continue
+                    if _is_identity_test(node.test):
+                        continue
+                    for read in _traced_reads(node.test, traced):
+                        yield Finding(
+                            self.id, mod.path, node.lineno, node.col_offset,
+                            f"Python-level `{type(node).__name__.lower()}` "
+                            f"in jitted `{fn.name}` reads traced parameter "
+                            f"`{read.id}` — trace-time crash or a silent "
+                            f"per-value recompile; branch on shapes/statics "
+                            f"or use lax.cond",
+                            mod.line_text(node.lineno),
+                        )

@@ -21,7 +21,7 @@ import time
 
 import numpy as np
 
-from albedo_tpu.cli import register_job
+from albedo_tpu.cli import EXIT_FAILURE, EXIT_REFUSED, register_job
 from albedo_tpu.datasets import (
     load_or_create_raw_tables,
     load_raw_tables,
@@ -864,7 +864,7 @@ def drop_data_job(args) -> None:
         import sys
 
         print("[drop_data] refusing to truncate without --yes", file=sys.stderr)
-        return 3  # nonzero: automation must not mistake a refusal for success
+        return EXIT_REFUSED  # automation must not mistake a refusal for success
     with EntityStore(ns.db) as store:
         before = store.counts()
         store.drop_data()
@@ -915,7 +915,7 @@ def datacheck_job(args) -> int | None:
     print(f"[datacheck] rows = {report.rows_in} -> {report.rows_out} "
           f"(policy would drop {report.total})")
     _report("datacheck", "violations", float(report.total), t0)
-    return 1 if report.total else None
+    return EXIT_FAILURE if report.total else None
 
 
 @register_job("cv_lr")

@@ -33,7 +33,7 @@ import time
 from pathlib import Path
 from typing import Callable
 
-from albedo_tpu.cli import register_job
+from albedo_tpu.cli import EXIT_FAILURE, EXIT_REJECTED, register_job
 from albedo_tpu.utils import faults
 from albedo_tpu.utils.checkpoint import Preempted
 from albedo_tpu.utils.jsonio import atomic_write_json, read_json_or_none
@@ -438,11 +438,11 @@ def run_pipeline_job(args) -> int | None:
     except PublishRejected as e:
         print(f"[run_pipeline] PUBLISH REFUSED by the canary gate: {e} "
               f"(artifact trained but NOT stamped; --publish-force overrides)")
-        return 4
+        return EXIT_REJECTED
     except PipelineStageFailed as e:
         print(f"[run_pipeline] FAILED: {e} (journal has the record; rerun "
               f"with --resume to retry from there)")
-        return 1
+        return EXIT_FAILURE
     done = [n for n, r in journal["stages"].items() if r["status"] == "done"]
     print(f"[run_pipeline] stages complete = {len(done)}/{len(journal['stages'])}")
     print(f"[run_pipeline] wall-clock = {time.time() - t0:.1f}s")

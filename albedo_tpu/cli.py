@@ -11,6 +11,19 @@ import argparse
 import sys
 from typing import Callable
 
+# The process exit-code contract — ONE definition, used by every job module
+# and enforced both directions (code <-> ARCHITECTURE.md table) by
+# graftlint's contract-drift rule (albedo_tpu/analysis). Automation keys off
+# these: a scheduler reruns 75 with --resume, treats 3/4 as verdicts (the
+# same input produces the same answer), and pages on 1.
+EXIT_OK = 0
+EXIT_FAILURE = 1       # crash / stage failure / datacheck violations
+EXIT_USAGE = 2         # bad invocation (argparse convention)
+EXIT_REFUSED = 3       # verdict: training/fold-in diverged, or an explicit refusal
+EXIT_REJECTED = 4      # verdict: canary/publish gate rejected the artifact
+EXIT_PREEMPTED = 75    # EX_TEMPFAIL: checkpointed under SIGTERM; rerun --resume
+EXIT_KILLED = 137      # SIGKILL (preempted pod / injected kill fault)
+
 _JOBS: dict[str, Callable[[argparse.Namespace], None]] = {}
 
 
@@ -107,7 +120,7 @@ def main(argv: list[str] | None = None) -> int:
     args._rest = _rest  # job-specific flags (e.g. collect_data --db/--token)
     if args.job not in _JOBS:
         print(f"no such job: {args.job}", file=sys.stderr)
-        return 2
+        return EXIT_USAGE
     # After arg validation: persistent executable cache, so repeat job
     # submissions skip XLA compile. Env-var-based when jax isn't imported
     # yet — host-only jobs never pay the jax import for this. Opt out with
@@ -139,9 +152,9 @@ def main(argv: list[str] | None = None) -> int:
         # SIGTERM/SIGINT landed mid-fit and the loop checkpointed: exit
         # clean-but-incomplete (EX_TEMPFAIL) so schedulers rerun with --resume.
         print(f"[cli] {e}; rerun with --resume to continue", file=sys.stderr)
-        return 75
+        return EXIT_PREEMPTED
     # Jobs may return an int exit code (e.g. drop_data's refusal); None = ok.
-    return int(rc) if isinstance(rc, int) else 0
+    return int(rc) if isinstance(rc, int) else EXIT_OK
 
 
 def _load_builders() -> None:

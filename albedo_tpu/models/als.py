@@ -663,6 +663,10 @@ class ImplicitALS:
                     user_f, item_f = compiled_step(
                         user_f, item_f, ug, ig, reg, alpha, one, **step_kwargs
                     )
+                    # The checkpoint callback's contract IS a host copy per
+                    # chunk boundary (utils/checkpoint materializes exactly
+                    # these) — an intentional, paid-for sync, not a hidden one.
+                    # albedo: noqa[hidden-host-sync]
                     callback(it, np.asarray(user_f), np.asarray(item_f))
         # Synchronize via a tiny device->host read of values that depend on
         # the full computation: on the tunneled axon backend,
@@ -794,6 +798,8 @@ class ImplicitALS:
             item_f = half_sweep(user_f, item_f, item_buckets)
             user_f = half_sweep(item_f, user_f, user_buckets)
             if callback is not None:
+                # Checkpoint-callback host copies, by contract (see fit()).
+                # albedo: noqa[hidden-host-sync]
                 callback(it, np.asarray(user_f), np.asarray(item_f))
 
         from albedo_tpu.utils.watchdog import factor_health, health_dict

@@ -32,6 +32,7 @@ import optax
 
 from albedo_tpu.datasets.star_matrix import StarMatrix
 from albedo_tpu.ops.topk import topk_scores
+from albedo_tpu.utils.aot import persistent_aot_call
 
 
 @dataclasses.dataclass
@@ -163,7 +164,6 @@ class RankingFactorization:
 
         # Side-feature table enters as an argument (not a baked-in HLO
         # constant — see models/logistic_regression.py on the 413 failure mode).
-        @jax.jit
         def run(params, g, rows, cols, key):
             state = opt.init(params)
 
@@ -193,7 +193,22 @@ class RankingFactorization:
             (params, _), epoch_losses = jax.lax.scan(epoch, (params, state), ekeys)
             return params, epoch_losses
 
-        params, losses = run(params, g_items, rows, cols, kshuf)
+        # Acquired through the persistent AOT layer: this jit predated
+        # utils/aot and re-traced per fit() call (the closure is rebuilt each
+        # time); the AOT cache keys on shapes + hyperparameters instead, so
+        # repeat fits reuse the executable in-process and across processes
+        # with the fingerprint-verified export (graftlint R1).
+        run_jit = jax.jit(run)
+        (params, losses), _c_s, _src = persistent_aot_call(
+            run_jit, (params, g_items, rows, cols, kshuf), None, None,
+            key_parts=(
+                "ranking_mf_fit", jax.__version__, jax.default_backend(),
+                n_users, n_items, d_i, self.rank, self.batch_size,
+                self.negatives, self.epochs, self.learning_rate, self.reg,
+                n_pairs,
+            ),
+            name="ranking_mf_fit",
+        )
         item_bias = np.asarray(params["b"]) + np.asarray(g_items @ params["w"])
         return RankingFactorizationModel(
             user_factors=np.asarray(params["x"]),

@@ -209,6 +209,10 @@ def bucket_cg_body(
     return x
 
 
+# Per-bucket eager reference path (als_half_sweep): parity tests and small
+# interactive runs only — hot fits go through als_fit_fused/als_init_fit_fused,
+# which ARE acquired via utils/aot.
+# albedo: noqa[bare-jit]
 @functools.partial(jax.jit, donate_argnames=("target",))
 def solve_bucket(
     source: jax.Array,   # (n_source, k) fixed side's factors

@@ -20,6 +20,10 @@ import jax
 import jax.numpy as jnp
 
 
+# Single-request cold path (ALSModel.recommend) and an inlined building block
+# of the batched programs: the serving hot path acquires _gather_topk* through
+# utils/aot (serving/batcher.py); this standalone jit serves ad-hoc calls.
+# albedo: noqa[bare-jit]
 @functools.partial(jax.jit, static_argnames=("k", "item_block"))
 def topk_scores(
     user_factors: jax.Array,          # (U, r)

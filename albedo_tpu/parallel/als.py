@@ -83,6 +83,10 @@ def pad_bucket(b: Bucket, multiple: int) -> Bucket:
 def sharded_gramian(mesh: Mesh, axis: str = DATA_AXIS):
     """``F^T F`` for a row-sharded factor table: local partial Gramian + psum."""
 
+    # One (k, k) psum program per mesh, compiled once and memoized via
+    # sharded_fit_engine — no per-shape ladder, no cross-process cold cost
+    # worth an export; the bucket solves themselves go through utils/aot.
+    # albedo: noqa[bare-jit]
     @jax.jit
     @functools.partial(
         shard_map,
@@ -113,6 +117,9 @@ def make_sharded_solver(mesh: Mesh, axis: str = DATA_AXIS):
         out_specs=P(axis),
     )
 
+    # Explicit-collectives REFERENCE implementation (ShardedALSSweep):
+    # parity tests pin the fused path against it; it never runs in a fit job.
+    # albedo: noqa[bare-jit]
     @functools.partial(jax.jit, donate_argnames=("target",))
     def solve_bucket_sharded(source, yty, target, row_ids, idx, val, mask, reg, alpha):
         if row_ids.shape[0] % n_shards:
@@ -465,8 +472,10 @@ class ShardedALSFit:
             if callback is not None:
                 callback(
                     it,
-                    np.asarray(user_sh)[:n_users],
-                    np.asarray(item_sh)[:n_items],
+                    # Checkpoint-callback host copies, by contract (the
+                    # chunked refit journals exactly these per boundary).
+                    np.asarray(user_sh)[:n_users],   # albedo: noqa[hidden-host-sync]
+                    np.asarray(item_sh)[:n_items],   # albedo: noqa[hidden-host-sync]
                 )
         stats["upload_s"] = round(stats["upload_s"], 4)
         stats["n_shapes"] = len(self._executables)

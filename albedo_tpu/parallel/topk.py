@@ -105,7 +105,10 @@ def make_sharded_topk(
             out_specs=(u_spec, u_spec),
             check_vma=False,
         )
-    return jax.jit(fn)
+    # lru_cached per (mesh, k) and exercised by the multichip dryrun/parity
+    # legs only today; ROADMAP item 5 (device-resident sharded retrieval)
+    # is where this earns its AOT export, alongside per-shape pre-warming.
+    return jax.jit(fn)  # albedo: noqa[bare-jit]
 
 
 def sharded_topk_scores(

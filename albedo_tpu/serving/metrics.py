@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import threading
 
+from albedo_tpu.utils import events
 from albedo_tpu.utils.events import (  # noqa: F401  (re-exported API)
     DEFAULT_SIZE_BUCKETS,
     DEFAULT_TIME_BUCKETS,
@@ -39,66 +40,66 @@ class MetricsRegistry:
         # Core serving metrics, pre-registered so /metrics is stable from the
         # first scrape (counters render 0 before any traffic).
         self.requests = self.counter(
-            "albedo_requests_total", "HTTP requests by route and status code.",
+            events.REQUESTS_TOTAL, "HTTP requests by route and status code.",
             ("route", "status"),
         )
         self.request_latency = self.histogram(
-            "albedo_request_latency_seconds", "End-to-end request latency."
+            events.REQUEST_LATENCY_SECONDS, "End-to-end request latency."
         )
         self.batch_size = self.histogram(
-            "albedo_serving_batch_size",
+            events.SERVING_BATCH_SIZE,
             "Users per coalesced device batch (pre-padding).",
             DEFAULT_SIZE_BUCKETS,
         )
         self.batch_latency = self.histogram(
-            "albedo_serving_batch_seconds", "Device batch execution latency."
+            events.SERVING_BATCH_SECONDS, "Device batch execution latency."
         )
         self.cache_hits = self.counter(
-            "albedo_cache_hits_total", "Result-cache hits."
+            events.CACHE_HITS_TOTAL, "Result-cache hits."
         )
         self.cache_misses = self.counter(
-            "albedo_cache_misses_total", "Result-cache misses."
+            events.CACHE_MISSES_TOTAL, "Result-cache misses."
         )
         self.degraded = self.counter(
-            "albedo_degraded_total",
+            events.DEGRADED_TOTAL,
             "Requests answered on a degraded path, by reason.",
             ("reason",),
         )
         self.shed = self.counter(
-            "albedo_shed_total",
+            events.SHED_TOTAL,
             "Requests rejected with 429 (queue overflow or deadline shed).",
         )
         self.deadline_shed = self.counter(
-            "albedo_deadline_shed_total",
+            events.DEADLINE_SHED_TOTAL,
             "Requests shed by admission control: deadline expired while queued.",
         )
         # --- live-ops plane: hot swap + circuit breakers --------------------
         self.model_generation = self.gauge(
-            "albedo_model_generation",
+            events.MODEL_GENERATION,
             "Currently-promoted model generation (0 = none promoted yet).",
         )
         self.reloads = self.counter(
-            "albedo_reload_total",
+            events.RELOAD_TOTAL,
             "Hot-swap reload attempts by outcome (promoted/rejected/rolled_back).",
             ("outcome",),
         )
         self.reload_rejected = self.counter(
-            "albedo_reload_rejected_total",
+            events.RELOAD_REJECTED_TOTAL,
             "Hot-swap candidates rejected, by the validation gate that failed.",
             ("gate",),
         )
         self.generation_requests = self.counter(
-            "albedo_generation_requests_total",
+            events.GENERATION_REQUESTS_TOTAL,
             "Recommend requests answered, by the model generation that served them.",
             ("generation",),
         )
         self.breaker_state = self.gauge(
-            "albedo_breaker_state",
+            events.BREAKER_STATE,
             "Per-source circuit breaker state (0=closed, 1=half_open, 2=open).",
             ("source",),
         )
         self.breaker_transitions = self.counter(
-            "albedo_breaker_transitions_total",
+            events.BREAKER_TRANSITIONS_TOTAL,
             "Circuit breaker state transitions, by source and new state.",
             ("source", "to"),
         )
@@ -106,12 +107,12 @@ class MetricsRegistry:
         # Timer.snapshot values at scrape time) and Prometheus reserves
         # `_total` for counters — promtool flags the mismatch.
         self.stage_seconds = self.gauge(
-            "albedo_stage_seconds",
+            events.STAGE_SECONDS,
             "Cumulative per-stage wall-clock (Timer.snapshot totals).",
             ("stage",),
         )
         self.stage_calls = self.gauge(
-            "albedo_stage_calls",
+            events.STAGE_CALLS,
             "Cumulative per-stage call counts (Timer.snapshot counts).",
             ("stage",),
         )

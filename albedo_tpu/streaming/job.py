@@ -40,7 +40,7 @@ from pathlib import Path
 
 import numpy as np
 
-from albedo_tpu.cli import register_job
+from albedo_tpu.cli import EXIT_FAILURE, EXIT_REFUSED, EXIT_REJECTED, register_job
 from albedo_tpu.streaming.deltas import StarOverlay, validate_deltas
 from albedo_tpu.streaming.drift import DriftMonitor, probe_score
 from albedo_tpu.streaming.foldin import FoldInDiverged, FoldInEngine
@@ -505,13 +505,13 @@ def run_stream_job(args) -> int | None:
         journal = run_stream(ctx, args, opts)
     except FoldInDiverged as e:
         print(f"[run_stream] FOLD-IN DIVERGED: {e} (nothing published this cycle)")
-        return 3
+        return EXIT_REFUSED
     except PublishRejected as e:
         print(f"[run_stream] REFIT REFUSED by the canary gate: {e}")
-        return 4
+        return EXIT_REJECTED
     except PipelineStageFailed as e:
         print(f"[run_stream] REFIT FAILED: {e}")
-        return 1
+        return EXIT_FAILURE
     s = journal["summary"]
     print(
         f"[run_stream] {s['cycles']} cycle(s): {s['deltas_applied']} deltas "

@@ -376,13 +376,23 @@ def persistent_aot_executable(
             compiled = jax.jit(exported.call).lower(*args, **dyn_kwargs).compile()
             wrote_export = False
             try:
+                # serialize() can fail beyond IO: a pytree node type with no
+                # registered export serialization (e.g. optax optimizer
+                # states) raises ValueError. The program still compiled fine
+                # — it just cannot cross processes via the export layer, so
+                # the write is best-effort for ANY failure, never fatal.
+                blob = exported.serialize()
                 tmp = path.with_name(path.name + f".tmp{os.getpid()}")
                 path.parent.mkdir(parents=True, exist_ok=True)
-                tmp.write_bytes(exported.serialize())
+                tmp.write_bytes(blob)
                 os.replace(tmp, path)
                 wrote_export = True
-            except OSError:
-                pass  # cache write is best-effort, never fatal
+            except Exception as e:  # noqa: BLE001
+                if not isinstance(e, OSError):
+                    log.warning(
+                        "serializing AOT export of %s failed (%r); disk "
+                        "layer off for this program", name, e,
+                    )
             if wrote_export and fingerprint_enabled():
                 # Record what THIS (fresh-compiled) executable computes on
                 # the deterministic probe; deserializing processes must

@@ -167,6 +167,62 @@ class Histogram:
         yield f"{self.name}_count {snap['count']}"
 
 
+# --- the metric-name registry -------------------------------------------------
+#
+# Every ``albedo_*`` metric name in the codebase is defined HERE, once, as a
+# constant — the serving registry (serving/metrics.py) and the offline
+# counters below both build from these. graftlint's contract-drift rule
+# (albedo_tpu/analysis) enforces the discipline both ways: an inline
+# ``"albedo_..."`` literal anywhere else in the package is a finding, and so
+# is a registered name missing from the ARCHITECTURE.md metrics catalog.
+
+# Serving plane (serving/metrics.py MetricsRegistry).
+REQUESTS_TOTAL = "albedo_requests_total"
+REQUEST_LATENCY_SECONDS = "albedo_request_latency_seconds"
+SERVING_BATCH_SIZE = "albedo_serving_batch_size"
+SERVING_BATCH_SECONDS = "albedo_serving_batch_seconds"
+CACHE_HITS_TOTAL = "albedo_cache_hits_total"
+CACHE_MISSES_TOTAL = "albedo_cache_misses_total"
+DEGRADED_TOTAL = "albedo_degraded_total"
+SHED_TOTAL = "albedo_shed_total"
+DEADLINE_SHED_TOTAL = "albedo_deadline_shed_total"
+MODEL_GENERATION = "albedo_model_generation"
+RELOAD_TOTAL = "albedo_reload_total"
+RELOAD_REJECTED_TOTAL = "albedo_reload_rejected_total"
+GENERATION_REQUESTS_TOTAL = "albedo_generation_requests_total"
+BREAKER_STATE = "albedo_breaker_state"
+BREAKER_TRANSITIONS_TOTAL = "albedo_breaker_transitions_total"
+STAGE_SECONDS = "albedo_stage_seconds"
+STAGE_CALLS = "albedo_stage_calls"
+
+# Offline fault-tolerance plane (the process-global counters below).
+ARTIFACT_CORRUPTIONS_TOTAL = "albedo_artifact_corruptions_total"
+CHECKPOINT_FALLBACKS_TOTAL = "albedo_checkpoint_fallbacks_total"
+RETRY_ATTEMPTS_TOTAL = "albedo_retry_attempts_total"
+FAULTS_FIRED_TOTAL = "albedo_faults_fired_total"
+AOT_FINGERPRINT_MISMATCHES_TOTAL = "albedo_aot_fingerprint_mismatches_total"
+
+# Data-quality firewall (PR 5).
+DATA_VIOLATIONS_TOTAL = "albedo_data_violations_total"
+WATCHDOG_TRIPS_TOTAL = "albedo_watchdog_trips_total"
+PUBLISH_REJECTED_TOTAL = "albedo_publish_rejected_total"
+
+# Streaming plane (PR 6).
+STREAM_DELTAS_TOTAL = "albedo_stream_deltas_total"
+FOLDIN_USERS_TOTAL = "albedo_foldin_users_total"
+DRIFT_REFITS_TOTAL = "albedo_drift_refits_total"
+STREAM_PUBLISHES_TOTAL = "albedo_stream_publishes_total"
+
+# Capacity guardrails (PR 7).
+CAPACITY_VERDICTS_TOTAL = "albedo_capacity_verdicts_total"
+MESH_DEGRADED_TOTAL = "albedo_mesh_degraded_total"
+
+METRIC_NAMES: frozenset = frozenset(
+    v for k, v in list(globals().items())
+    if k.isupper() and isinstance(v, str) and v.startswith("albedo_")
+)
+
+
 # --- process-global offline counters -----------------------------------------
 
 _global_lock = threading.Lock()
@@ -208,26 +264,26 @@ def reset_global_metrics() -> None:
 # The offline fault-tolerance plane, pre-registered so /metrics exposes the
 # whole catalog from the first scrape.
 artifact_corruptions = global_counter(
-    "albedo_artifact_corruptions_total",
+    ARTIFACT_CORRUPTIONS_TOTAL,
     "Artifacts quarantined after failed checksum verification or load, by artifact name.",
     ("artifact",),
 )
 checkpoint_fallbacks = global_counter(
-    "albedo_checkpoint_fallbacks_total",
+    CHECKPOINT_FALLBACKS_TOTAL,
     "Unreadable checkpoint steps skipped while restoring the latest step.",
 )
 retry_attempts = global_counter(
-    "albedo_retry_attempts_total",
+    RETRY_ATTEMPTS_TOTAL,
     "Retries performed by utils.retry after a failed attempt, by call site.",
     ("site",),
 )
 faults_fired = global_counter(
-    "albedo_faults_fired_total",
+    FAULTS_FIRED_TOTAL,
     "Injected faults fired by the utils.faults harness, by site.",
     ("site",),
 )
 aot_fingerprint_mismatches = global_counter(
-    "albedo_aot_fingerprint_mismatches_total",
+    AOT_FINGERPRINT_MISMATCHES_TOTAL,
     "Serialized AOT executables discarded because their probe-output "
     "fingerprint did not match the exporting process's record.",
     ("name",),
@@ -235,20 +291,20 @@ aot_fingerprint_mismatches = global_counter(
 # The data-quality firewall (PR 5): ingest violations, training divergence
 # trips, and refused publishes all surface on the same /metrics page.
 data_violations = global_counter(
-    "albedo_data_violations_total",
+    DATA_VIOLATIONS_TOTAL,
     "Raw star rows flagged by the ingest validator, by rule "
     "(datasets.validate; dropped under --data-policy repair, fatal under "
     "strict).",
     ("rule",),
 )
 watchdog_trips = global_counter(
-    "albedo_watchdog_trips_total",
+    WATCHDOG_TRIPS_TOTAL,
     "Training divergence watchdog tripwires fired, by kind "
     "(nonfinite/norm/trajectory/lr).",
     ("kind",),
 )
 publish_rejected = global_counter(
-    "albedo_publish_rejected_total",
+    PUBLISH_REJECTED_TOTAL,
     "Artifacts refused publication or promotion, by gate "
     "(canary = pipeline quality gate, stamp = serving reload stamp gate).",
     ("gate",),
@@ -256,7 +312,7 @@ publish_rejected = global_counter(
 # The streaming plane (ROADMAP item 4): delta ingest routing, fold-in
 # throughput, and the drift monitor's refit trigger.
 stream_deltas = global_counter(
-    "albedo_stream_deltas_total",
+    STREAM_DELTAS_TOTAL,
     "Star deltas processed by the streaming ingest, by disposition "
     "(applied/tombstoned/folded_out = deferred to the next refit/"
     "dangling_tombstone/superseded = cross-op keep-last resolution/"
@@ -264,16 +320,16 @@ stream_deltas = global_counter(
     ("kind",),
 )
 foldin_users = global_counter(
-    "albedo_foldin_users_total",
+    FOLDIN_USERS_TOTAL,
     "User rows re-solved on device by the streaming fold-in engine.",
 )
 drift_refits = global_counter(
-    "albedo_drift_refits_total",
+    DRIFT_REFITS_TOTAL,
     "Full checkpointed refits triggered by the streaming drift monitor "
     "(quality decay past tolerance, or fold-out queue overflow).",
 )
 stream_publishes = global_counter(
-    "albedo_stream_publishes_total",
+    STREAM_PUBLISHES_TOTAL,
     "Incremental stream generations published to the artifact store, by "
     "outcome.",
     ("outcome",),
@@ -281,13 +337,13 @@ stream_publishes = global_counter(
 # The capacity guardrail plane (PR 7): admission verdicts at every dispatch
 # seam and degraded-mesh boots.
 capacity_verdicts = global_counter(
-    "albedo_capacity_verdicts_total",
+    CAPACITY_VERDICTS_TOTAL,
     "Memory-budget admission verdicts (utils.capacity), by verdict "
     "(fit/degrade/refuse) and workload (als_fit/serve/foldin/...).",
     ("verdict", "workload"),
 )
 mesh_degraded = global_counter(
-    "albedo_mesh_degraded_total",
+    MESH_DEGRADED_TOTAL,
     "Mesh constructions that remeshed to fewer devices than requested "
     "(device loss or an injected mesh.devices fault).",
 )

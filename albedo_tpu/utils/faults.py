@@ -218,7 +218,11 @@ class FaultRegistry:
                 _flip_byte(Path(path))
             return
         if spec.kind == "kill":
-            os._exit(137)  # the SIGKILL exit code a preempted pod reports
+            # The SIGKILL exit code a preempted pod reports (lazy import:
+            # the fault harness stays dependency-free for the offline layers).
+            from albedo_tpu.cli import EXIT_KILLED
+
+            os._exit(EXIT_KILLED)
         if spec.kind == "term":
             os.kill(os.getpid(), signal.SIGTERM)
             return

@@ -3,59 +3,28 @@
 Chaos coverage rots silently: a new ``faults.site("...")`` that never lands
 in the ARCHITECTURE.md catalog is invisible to operators writing
 ``ALBEDO_FAULTS`` specs, and a catalog row whose site was renamed away
-documents a drill that can never fire. This test extracts every site string
-from the package source (literal and f-string forms — ``{name}``-style
-interpolations normalize to ``<name>``) and diffs it against the catalog
-table, both directions.
+documents a drill that can never fire.
+
+The implementation now lives in graftlint's contract-drift rule
+(``albedo_tpu/analysis/rules_contract.py``) — ONE catalog lint, shared by
+this test, the ``make lint`` CLI, and the tier-1 self-lint. These entry
+points are kept so the original drill names stay green and the anchor list
+keeps guarding against the extractors silently matching nothing.
 """
 
-import re
-from pathlib import Path
-
-PKG = Path(__file__).resolve().parent.parent / "albedo_tpu"
-ARCH = Path(__file__).resolve().parent.parent / "ARCHITECTURE.md"
-
-# faults.site("x") / faults.hit("x") / faults.arm("x") / site("x"), with an
-# optional f-prefix on the string literal.
-_SITE_CALL = re.compile(
-    r"""(?:faults\.)?(?:site|hit|arm)\(\s*(f?)(['"])([^'"]+)\2"""
+from albedo_tpu.analysis import default_tree
+from albedo_tpu.analysis.rules_contract import (
+    fault_sites_in_catalog,
+    fault_sites_in_code,
 )
-# Backticked dotted names in the first cell of a catalog table row (a cell
-# may list several variants: `pipeline.stage`, `pipeline.stage.<name>`).
-_CATALOG_NAME = re.compile(r"`([a-z_.<>]+)`")
-
-
-def _normalize(site: str, is_fstring: bool) -> str:
-    if is_fstring:
-        return re.sub(r"\{[^}]*\}", "<name>", site)
-    return site
 
 
 def sites_in_code() -> set[str]:
-    found = set()
-    for py in PKG.rglob("*.py"):
-        if py.name == "faults.py":
-            continue  # the harness itself (docstrings + generic helpers)
-        text = py.read_text()
-        for m in _SITE_CALL.finditer(text):
-            site = _normalize(m.group(3), bool(m.group(1)))
-            # Only dotted, lowercase names are fault sites; this keeps
-            # unrelated single-word site()/hit() call patterns out.
-            if "." in site and site == site.lower():
-                found.add(site)
-    return found
+    return set(fault_sites_in_code(default_tree()))
 
 
 def sites_in_catalog() -> set[str]:
-    sites = set()
-    for line in ARCH.read_text().splitlines():
-        if not line.startswith("|"):
-            continue
-        first_cell = line.split("|")[1]
-        for m in _CATALOG_NAME.finditer(first_cell):
-            if "." in m.group(1):
-                sites.add(m.group(1))
-    return sites
+    return fault_sites_in_catalog(default_tree())
 
 
 def test_every_code_site_is_catalogued():
@@ -79,7 +48,7 @@ def test_every_catalogued_site_exists_in_code():
 
 def test_known_sites_are_present():
     """Anchor: the lint must actually see the known surface (guards against
-    the regexes silently matching nothing)."""
+    the extractors silently matching nothing)."""
     code = sites_in_code()
     for site in (
         "artifact.load", "checkpoint.save", "crawler.transport",
