@@ -8,11 +8,11 @@ ARGS ?=
 JOBS = popularity curation content train_als cv_als build_user_profile \
        build_repo_profile train_word2vec train_lr cv_lr item_cf user_cf \
        tfidf_content ranking_mf collect_data drop_data sync_index serve play \
-       run_pipeline datacheck run_stream
+       run_pipeline datacheck run_stream build_bank
 
 .PHONY: $(JOBS) test test-all bench serve-bench datacheck-bench chaos \
         chaos-serve chaos-stream stream stream-bench dryrun soak soak-smoke \
-        capacity-bench lint lint-baseline
+        capacity-bench retrieval-bench lint lint-baseline
 
 $(JOBS):
 	$(PY) -m albedo_tpu.cli $@ $(ARGS)
@@ -96,6 +96,13 @@ soak-smoke:
 # (interleaved trials, medians — per the bench-box throttling policy).
 capacity-bench:
 	$(PY) bench.py capacity
+
+# Retrieval scenario: the bank-backed fused candidate stage vs the threaded
+# per-source fan-out over identical sources — candidate-set parity gate
+# first, then interleaved closed-loop trials (sustained candidate rps,
+# p50/p99, achieved GB/s) -> RETRIEVAL_r01.json.
+retrieval-bench:
+	JAX_PLATFORMS=cpu $(PY) bench.py retrieval
 
 # ALX-scale weak scaling: the fully sharded streamed fit at 1 -> 2 -> 4 -> 8
 # chips with fixed work per chip (out-of-core synthetic star matrices),

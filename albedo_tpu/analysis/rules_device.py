@@ -43,6 +43,7 @@ DEVICE_PACKAGES = (
     "albedo_tpu/models/",
     "albedo_tpu/ops/",
     "albedo_tpu/parallel/",
+    "albedo_tpu/retrieval/",
     "albedo_tpu/serving/",
     "albedo_tpu/streaming/",
 )

@@ -698,6 +698,75 @@ def tfidf_content_job(args) -> None:
     _report("tfidf_content", "indexed_repos", float(len(search.doc_ids)), t0)
 
 
+def _context_bank(ctx, with_user_sim: bool = False, with_als: bool = True):
+    """Assemble the default retrieval bank from this context's trained
+    artifacts (ALS factors + the Word2Vec content index + the TF-IDF
+    projection) — one definition shared by ``build_bank`` and
+    ``serve --bank`` (which passes ``with_als=False``: its stage serves
+    only the MLT sources, so the factor tables must not be pinned or
+    capacity-priced twice)."""
+    from albedo_tpu.recommenders import EmbeddingSearchBackend
+    from albedo_tpu.recommenders.tfidf import TfidfSimilaritySearch
+    from albedo_tpu.retrieval.build import build_default_bank
+
+    tables = ctx.tables()
+    backend = EmbeddingSearchBackend(tables.repo_info, ctx.word2vec())
+    search = TfidfSimilaritySearch(min_df=2).fit(tables.repo_info)
+    bank = build_default_bank(
+        ctx.als_model(), ctx.matrix(),
+        starring_df=tables.starring,
+        content_backend=backend, tfidf_search=search,
+        with_user_sim=with_user_sim, with_als=with_als, top_k=TOP_K,
+    )
+    return bank, backend, search
+
+
+@register_job("build_bank")
+def build_bank_job(args) -> int | None:
+    """Build (or inspect) the unified retrieval bank: every embedding-backed
+    candidate source — ALS factors, Word2Vec content embeddings, the TF-IDF
+    projection, optionally the user-similarity table — sealed into ONE
+    stamped, manifest-sealed device-servable artifact
+    (``albedo_tpu.retrieval``; see the README retrieval runbook).
+
+    Extra flags: --user-sim (register the user-to-user source),
+    --inspect (print the existing artifact's stamp and exit).
+    """
+    from albedo_tpu.datasets.artifacts import read_meta, artifact_path
+    from albedo_tpu.retrieval import bank_artifact_name
+
+    t0 = time.time()
+    extra = argparse.ArgumentParser()
+    extra.add_argument("--user-sim", action="store_true")
+    extra.add_argument("--inspect", action="store_true")
+    ns, _ = extra.parse_known_args(getattr(args, "_rest", []))
+
+    ctx = JobContext(args)
+    name = bank_artifact_name(ctx.tag)
+    if ns.inspect:
+        meta = read_meta(artifact_path(name))
+        if meta is None:
+            print(f"[build_bank] no stamped bank at {name}")
+            return EXIT_FAILURE
+        import json as _json
+
+        print(_json.dumps(meta.get("bank", meta), indent=2))
+        return None
+    bank, _, _ = _context_bank(ctx, with_user_sim=ns.user_sim)
+    bank.save(name, lineage={
+        "als_artifact": ctx.als_artifact_name(),
+        "word2vec_artifact": ctx.word2vec_artifact_name(),
+        "tag": ctx.tag,
+    })
+    for sname, info in bank.manifest()["sources"].items():
+        print(
+            f"[build_bank] {sname}: {info['rows']} rows x {info['dim']} dims "
+            f"(calibration scale {info['calibration'].get('scale')})"
+        )
+    print(f"[build_bank] sealed {name} (version {bank.version})")
+    _report("build_bank", "sources", float(len(bank.specs)), t0)
+
+
 @register_job("serve")
 def serve_job(args) -> None:
     """The online inference engine over trained artifacts: micro-batched
@@ -735,11 +804,20 @@ def serve_job(args) -> None:
     extra.add_argument("--reload-watch", action="store_true")
     extra.add_argument("--reload-interval", type=float, default=10.0)
     extra.add_argument("--reload-require-stamp", action="store_true")
+    extra.add_argument("--bank", action="store_true")
     ns, _ = extra.parse_known_args(getattr(args, "_rest", []))
 
     ctx = JobContext(args)
     recommenders = None
     ranker = None
+    bank_stage = None
+    if ns.bank and not ns.two_stage:
+        import sys
+
+        print(
+            "[serve] --bank requires --two-stage (the bank is a stage-1 "
+            "candidate plane); ignoring --bank", file=sys.stderr,
+        )
     if ns.two_stage:
         lo, hi = ctx.star_range()
         recommenders = {
@@ -753,13 +831,32 @@ def serve_job(args) -> None:
             ),
         }
         ranker = ctx.ranker_model()
+        if ns.bank:
+            # The bank-backed candidate stage: content + tfidf answered in
+            # one fused device pass (the "als" rows stay on the generation-
+            # snapshot batcher source, so hot swaps keep their invariant).
+            from albedo_tpu.recommenders import ContentRecommender, TfidfRecommender
+            from albedo_tpu.retrieval import BankStage
+
+            bank, content_backend, search = _context_bank(ctx, with_als=False)
+            tables = ctx.tables()
+            fallbacks = {
+                "content": ContentRecommender(
+                    content_backend, tables.starring, top_k=TOP_K
+                ),
+                "tfidf": TfidfRecommender(search, tables.starring, top_k=TOP_K),
+            }
+            bank_stage = BankStage(
+                bank, ctx.matrix(),
+                sources=("content", "tfidf"), fallbacks=fallbacks, top_k=TOP_K,
+            )
     service = RecommendationService(
         ctx.als_model(), ctx.matrix(),
         repo_info=ctx.tables().repo_info, user_info=ctx.tables().user_info,
         recommenders=recommenders, ranker=ranker,
         batching=not ns.no_batch, warm=not ns.no_batch and not ns.no_warm,
         cache_ttl=ns.cache_ttl, max_batch=ns.max_batch,
-        batch_window_ms=ns.window_ms,
+        batch_window_ms=ns.window_ms, bank_stage=bank_stage,
     )
     # Live-ops plane: the hot-swap manager always exists (SIGHUP and
     # POST /admin/reload work out of the box); --reload-watch additionally

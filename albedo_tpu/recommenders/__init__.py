@@ -15,6 +15,7 @@ from albedo_tpu.recommenders.content import (
 )
 from albedo_tpu.recommenders.curation import CURATOR_IDS, CurationRecommender
 from albedo_tpu.recommenders.popularity import PopularityRecommender
+from albedo_tpu.recommenders.tfidf import TfidfRecommender, TfidfSimilaritySearch
 
 __all__ = [
     "ALSRecommender",
@@ -25,5 +26,7 @@ __all__ = [
     "PopularityRecommender",
     "Recommender",
     "SearchBackend",
+    "TfidfRecommender",
+    "TfidfSimilaritySearch",
     "fuse_candidates",
 ]

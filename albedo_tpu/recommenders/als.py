@@ -41,6 +41,24 @@ class ALSRecommender(Recommender):
         self.item_block = item_block
         self.mesh = mesh
 
+    def bank_registration(self):
+        """The trained factors as a retrieval-bank ``user_rows`` source:
+        item factors are the scored table, user factors the query table
+        (row-aligned with the matrix's dense users by construction), and
+        the source opts into the shared seen-item exclusion table exactly
+        when this recommender excludes seen items."""
+        from albedo_tpu.retrieval.bank import BankSourceSpec
+
+        return BankSourceSpec(
+            name=self.source,
+            kind="user_rows",
+            vectors=np.asarray(self.model.item_factors, dtype=np.float32),
+            item_ids=self.matrix.item_ids,
+            user_vectors=np.asarray(self.model.user_factors, dtype=np.float32),
+            exclude_seen=self.exclude_seen,
+            owner=self.model,
+        )
+
     def recommend_for_users(self, user_ids: np.ndarray) -> pd.DataFrame:
         dense = self.matrix.users_of(user_ids)
         known = dense >= 0

@@ -18,7 +18,7 @@ from __future__ import annotations
 import numpy as np
 import pandas as pd
 
-from albedo_tpu.recommenders.base import Recommender
+from albedo_tpu.recommenders.base import Recommender, recent_starred_provider
 
 
 class SearchBackend:
@@ -55,6 +55,7 @@ class EmbeddingSearchBackend(SearchBackend):
         self, query_items: list[np.ndarray], k: int
     ) -> list[tuple[np.ndarray, np.ndarray]]:
         from albedo_tpu.ops.topk import topk_scores
+        from albedo_tpu.utils.devcache import device_put_cached
 
         n_q = len(query_items)
         if n_q == 0:
@@ -73,8 +74,12 @@ class EmbeddingSearchBackend(SearchBackend):
                 has_query[qi] = True
         import jax.numpy as jnp
 
+        # The embedding table's device copy is cached per backend identity
+        # (weakref) — re-uploading the whole (N, d) projection per MLT call
+        # was a full host->device copy of the table on every request.
+        vectors_dev = device_put_cached(self, self.vectors)
         vals, idx = topk_scores(
-            jnp.asarray(queries), jnp.asarray(self.vectors), k=k,
+            jnp.asarray(queries), vectors_dev, k=k,
             exclude_idx=jnp.asarray(exclude),
         )
         vals, idx = np.asarray(vals), np.asarray(idx)
@@ -103,24 +108,37 @@ class ContentRecommender(Recommender):
     ):
         super().__init__(**kwargs)
         self.backend = backend
-        # Pre-group once: per-user repo lists sorted newest-first, so batch
-        # query assembly is O(|starring| log) total instead of a full-table
-        # scan per user.
-        s = starring_df.sort_values("starred_at", ascending=False, kind="stable")
-        self._user_repos: dict[int, np.ndarray] = {
-            int(uid): grp.to_numpy(np.int64)
-            for uid, grp in s.groupby("user_id", sort=False)["repo_id"]
-        }
         # Eval mode: query with the NEXT topK starred repos so candidates are
         # not the held-out query items (ContentRecommender.scala:44-46).
         self.enable_evaluation_mode = enable_evaluation_mode
+        # The shared recency provider (recommenders.base) — one definition
+        # with the tf-idf source and the retrieval bank's query providers.
+        self._user_recent_repos = recent_starred_provider(
+            starring_df,
+            top_k=self.top_k,
+            offset=self.top_k if enable_evaluation_mode else 0,
+        )
 
-    def _user_recent_repos(self, user_id: int) -> np.ndarray:
-        repos = self._user_repos.get(int(user_id))
-        if repos is None:
-            return np.zeros(0, dtype=np.int64)
-        offset = self.top_k if self.enable_evaluation_mode else 0
-        return repos[offset : offset + self.top_k]
+    def bank_registration(self):
+        """This source as a retrieval-bank ``item_mean`` registration.
+
+        Requires an embedding-backed backend (the table IS the source); a
+        truly external search service has no rows to register — it stays on
+        the breaker-guarded thread fan-out, which is exactly the boundary
+        the bank draws."""
+        from albedo_tpu.retrieval.bank import BankSourceSpec
+
+        backend = self.backend
+        if not hasattr(backend, "vectors") or not hasattr(backend, "item_ids"):
+            raise TypeError(
+                "external search backends are not bank-registrable; keep "
+                "this source on the breaker fan-out path"
+            )
+        return BankSourceSpec(
+            name=self.source, kind="item_mean", vectors=backend.vectors,
+            item_ids=backend.item_ids, query_items=self._user_recent_repos,
+            owner=backend,
+        )
 
     def recommend_for_users(self, user_ids: np.ndarray) -> pd.DataFrame:
         users = np.asarray(user_ids, dtype=np.int64)

@@ -13,6 +13,12 @@ similarity search is a device GEMM — the L2-normalized tf-idf matrix lives on
 device and a query row's cosine similarities against every document come from
 one (D, V) x (V,) matvec + ``lax.top_k``, never a materialized D x D kernel
 matrix (the reference builds the full ``linear_kernel`` square).
+
+The projected matrix is held as a HOST array (picklable, bank-registrable)
+with device residency cached per model identity (``utils.devcache`` — the
+weakref pattern of LR's matrix cache), so the similar-repo query path, the
+candidate recommender below, and a retrieval-bank build all share ONE
+device copy instead of each re-uploading the projection per call.
 """
 
 from __future__ import annotations
@@ -21,11 +27,12 @@ import re
 from collections import Counter
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 import pandas as pd
 
 from albedo_tpu.features.text import ENGLISH_STOP_WORDS, porter_stem
+from albedo_tpu.recommenders.base import Recommender, recent_starred_provider
+from albedo_tpu.utils.devcache import device_put_cached
 
 _RE_SK_TOKEN = re.compile(r"(?u)\b\w\w+\b")  # sklearn's default token_pattern
 
@@ -56,7 +63,7 @@ class TfidfSimilaritySearch:
         self.vocab: dict[str, int] = {}
         self.idf: np.ndarray | None = None
         self.doc_ids: np.ndarray | None = None
-        self._matrix = None  # (D, V) L2-normalized tf-idf, device array
+        self.matrix = None  # (D, V) L2-normalized tf-idf, HOST float32
 
     def fit(self, repo_df: pd.DataFrame) -> "TfidfSimilaritySearch":
         """``repo_df``: repo_id, repo_full_name, repo_language,
@@ -93,8 +100,14 @@ class TfidfSimilaritySearch:
 
         self.doc_ids = repo_df["repo_id"].to_numpy(np.int64)
         self._names = repo_df["repo_full_name"].astype(str).to_list()
-        self._matrix = jnp.asarray(mat)
+        self.matrix = mat.astype(np.float32)
+        self._doc_row = {int(i): r for r, i in enumerate(self.doc_ids)}
         return self
+
+    def _device_matrix(self):
+        """The projection's device residency — computed at most once per
+        model identity (weakref-cached), never per call."""
+        return device_put_cached(self, self.matrix)
 
     def similar(self, repo_full_name: str, k: int = 49) -> list[tuple[float, str]]:
         """Top-k most similar repos to the named repo (the reference prints
@@ -104,7 +117,8 @@ class TfidfSimilaritySearch:
         except ValueError:
             return []
         k = min(k + 1, len(self._names))
-        sims = self._matrix @ self._matrix[q]          # one device matvec
+        dev = self._device_matrix()
+        sims = dev @ dev[q]                            # one device matvec
         vals, idx = jax.lax.top_k(sims, k)
         out = [
             (float(v), self._names[int(i)])
@@ -112,3 +126,95 @@ class TfidfSimilaritySearch:
             if int(i) != q
         ]
         return out[: k - 1]
+
+    def similar_to_repos(
+        self, query_items: list[np.ndarray], k: int
+    ) -> list[tuple[np.ndarray, np.ndarray]]:
+        """Batched More-Like-This over raw repo ids: per query, the cosine
+        top-k against the L2-normalized mean of the query rows, query rows
+        excluded — the same contract as
+        ``content.EmbeddingSearchBackend.more_like_this``, and the bank's
+        host-side parity baseline for the ``tfidf`` source."""
+        import jax.numpy as jnp
+
+        from albedo_tpu.ops.topk import topk_scores
+
+        n_q = len(query_items)
+        if n_q == 0:
+            return []
+        dim = self.matrix.shape[1]
+        queries = np.zeros((n_q, dim), dtype=np.float32)
+        max_q = max((len(q) for q in query_items), default=1)
+        exclude = np.full((n_q, max(1, max_q)), -1, dtype=np.int32)
+        has_query = np.zeros(n_q, dtype=bool)
+        for qi, items in enumerate(query_items):
+            rows = [self._doc_row[int(i)] for i in items if int(i) in self._doc_row]
+            if rows:
+                v = self.matrix[rows].mean(axis=0)
+                queries[qi] = v / max(float(np.linalg.norm(v)), 1e-9)
+                exclude[qi, : len(rows)] = rows
+                has_query[qi] = True
+        vals, idx = topk_scores(
+            jnp.asarray(queries), self._device_matrix(),
+            k=min(k, len(self.doc_ids)), exclude_idx=jnp.asarray(exclude),
+        )
+        vals, idx = np.asarray(vals), np.asarray(idx)
+        empty = (np.zeros(0, dtype=np.int64), np.zeros(0))
+        out = []
+        for qi in range(n_q):
+            if not has_query[qi]:
+                out.append(empty)
+                continue
+            ok = (idx[qi] >= 0) & np.isfinite(vals[qi])
+            out.append((self.doc_ids[idx[qi][ok]], vals[qi][ok].astype(np.float64)))
+        return out
+
+    def bank_registration(self, query_items=None, name: str = "tfidf"):
+        """This projection as a retrieval-bank ``item_mean`` source — the
+        bank build reads the same host matrix the query paths project, so
+        neither side re-derives it (``owner=self`` keys the shared device
+        residency)."""
+        from albedo_tpu.retrieval.bank import BankSourceSpec
+
+        if self.matrix is None:
+            raise RuntimeError("fit() the tf-idf index before registering it")
+        return BankSourceSpec(
+            name=name, kind="item_mean", vectors=self.matrix,
+            item_ids=self.doc_ids, query_items=query_items, owner=self,
+        )
+
+
+class TfidfRecommender(Recommender):
+    """The TF-IDF projection as a stage-1 candidate source: per user, More-
+    Like-This over their most recent stars — the legacy content-based
+    trainer promoted from a print-only job to a pipeline source (and the
+    host-side fallback path behind the bank's ``tfidf`` rows)."""
+
+    source = "tfidf"
+
+    def __init__(self, search: TfidfSimilaritySearch, starring_df: pd.DataFrame, **kwargs):
+        super().__init__(**kwargs)
+        self.search = search
+        self._user_recent_repos = recent_starred_provider(
+            starring_df, top_k=self.top_k
+        )
+
+    def recommend_for_users(self, user_ids: np.ndarray) -> pd.DataFrame:
+        users = np.asarray(user_ids, dtype=np.int64)
+        queries = [self._user_recent_repos(int(u)) for u in users]
+        results = self.search.similar_to_repos(queries, self.top_k)
+        if not results:
+            return self._frame(np.zeros(0), np.zeros(0), np.zeros(0))
+        return self._frame(
+            np.concatenate([
+                np.full(items.shape[0], u, dtype=np.int64)
+                for u, (items, _) in zip(users, results)
+            ]),
+            np.concatenate([items for items, _ in results]),
+            np.concatenate([scores for _, scores in results]),
+        )
+
+    def bank_registration(self):
+        return self.search.bank_registration(
+            query_items=self._user_recent_repos
+        )

@@ -1,0 +1,27 @@
+"""Unified on-device candidate retrieval (ROADMAP item 5).
+
+One device-resident, mesh-shardable **embedding bank** replaces the
+host-side candidate fan-out for every source that is really just a dot
+product against an embedding table: ALS item factors, Word2Vec repo
+embeddings, TF-IDF projections, and user rows (user-to-user / similar-repo
+scenarios). Serving queries become a single fused gather -> blocked GEMM ->
+top-k device pass per batch instead of N host threads with per-source
+deadlines and breakers — the breaker machinery remains only for sources
+that are truly external.
+"""
+
+from albedo_tpu.retrieval.bank import (
+    BankSourceSpec,
+    RetrievalBank,
+    bank_artifact_name,
+)
+from albedo_tpu.retrieval.parity import candidate_parity
+from albedo_tpu.retrieval.stage import BankStage
+
+__all__ = [
+    "BankSourceSpec",
+    "BankStage",
+    "RetrievalBank",
+    "bank_artifact_name",
+    "candidate_parity",
+]

@@ -20,7 +20,16 @@ latency budget, so every stage gets a deadline and a degradation path:
   coalesce into shared device batches. The live ALS source is supplied
   per-request via ``extra_sources`` — the service passes the source from
   its current :class:`~albedo_tpu.serving.service.ModelGeneration`
-  snapshot, so a hot-swap can never tear a request across two models.
+  snapshot, so a hot-swap can never tear a request across two models;
+- sources carried by a **retrieval bank**
+  (:class:`~albedo_tpu.retrieval.stage.BankStage`) skip the thread fan-out
+  entirely: one bank task answers all of them in a single fused device
+  pass. A bank failure (timeout or error) degrades to the **host-side
+  per-source path** for exactly the sources it covered — tagged
+  ``bank_timeout``/``bank_error`` and counted in
+  ``albedo_retrieval_fallbacks_total{reason}`` — never a 500. Breakers
+  remain only on the threaded (truly external / host) sources; the bank
+  path's failure containment IS the fallback.
 
 Every degraded answer is tagged in the response (``"degraded": [reasons]``)
 and counted in ``albedo_degraded_total{reason=...}``; per-stage wall-clock
@@ -53,7 +62,7 @@ _RANK_FAULT = faults.site("serving.rank")
 # Fusion priority: duplicates keep the FIRST source's row (reference
 # ``reduce(union).distinct`` keeps one arbitrary row; we pin the order so
 # the ALS score survives a collision with a curation/popularity row).
-SOURCE_ORDER = ("als", "curation", "content", "popularity")
+SOURCE_ORDER = ("als", "curation", "content", "tfidf", "popularity")
 
 
 class BatchedALSSource(Recommender):
@@ -137,9 +146,11 @@ class TwoStagePipeline:
         timer: Timer | None = None,
         breaker_config: BreakerConfig | None = None,
         breakers_enabled: bool = True,
+        bank_stage=None,  # retrieval.stage.BankStage: fused candidate pass
     ):
         self.recommenders = dict(recommenders)
         self.ranker = ranker
+        self.bank_stage = bank_stage
         self.deadlines = deadlines or StageDeadlines()
         self.metrics = metrics
         self.timer = timer if timer is not None else Timer()
@@ -251,8 +262,32 @@ class TwoStagePipeline:
                 return rec.recommend_for_users(users, exclude_seen)
             return rec.recommend_for_users(users)
 
+        all_sources = self._sources(extra_sources)
+        # Bank-resident sources skip the thread fan-out: ONE submitted task
+        # answers all of them in a fused device pass. The generation-snapshot
+        # ALS source (extra_sources) wins over a bank registration of the
+        # same name — snapshot consistency across hot swaps is the PR 4
+        # invariant and the bank must not weaken it.
+        bank = self.bank_stage
+        bank_names: list[str] = []
+        bank_fut: Future | None = None
+        if bank is not None:
+            bank_names = [
+                n for n in bank.source_names
+                if not (extra_sources and n in extra_sources)
+            ]
+            if bank_names:
+                # Restricted to bank_names: the stage may carry more sources
+                # (e.g. "als") than this request lets it serve — a bank
+                # frame must never clobber the generation snapshot's.
+                bank_fut = self._pool.submit(
+                    bank.query_frames, int(user_id), None, exclude_seen,
+                    tuple(bank_names),
+                )
         futs: dict[str, Future] = {}
-        for name, rec in self._sources(extra_sources).items():
+        for name, rec in all_sources.items():
+            if name in bank_names:
+                continue  # the bank answers it; the recommender is fallback
             br = self._breaker(name)
             if br is not None and not br.allow():
                 self._degrade(degraded, f"breaker_open_{name}")
@@ -262,40 +297,84 @@ class TwoStagePipeline:
         eff_deadline = (
             stage_deadline if deadline is None else min(stage_deadline, deadline)
         )
-        frames: dict[str, pd.DataFrame] = {}
-        for name, fut in futs.items():
-            br = self._breaker(name)
-            try:
-                frames[name] = fut.result(
-                    timeout=max(0.0, eff_deadline - time.monotonic())
-                )
-                if br is not None:
-                    br.record_success()
-            except FutureTimeout:
-                fut.cancel()
-                self._degrade(degraded, f"candidate_timeout_{name}")
-                if br is not None:
-                    if time.monotonic() >= stage_deadline:
+
+        def collect(pending: dict[str, Future], frames: dict) -> None:
+            for name, fut in pending.items():
+                br = self._breaker(name)
+                try:
+                    frames[name] = fut.result(
+                        timeout=max(0.0, eff_deadline - time.monotonic())
+                    )
+                    if br is not None:
+                        br.record_success()
+                except FutureTimeout:
+                    fut.cancel()
+                    self._degrade(degraded, f"candidate_timeout_{name}")
+                    if br is not None:
+                        if time.monotonic() >= stage_deadline:
+                            br.record_failure()
+                        else:
+                            br.abandon_trial()
+                except BatcherClosed:
+                    # The request's generation snapshot lost a race with a
+                    # hot-swap retirement. Not a source failure (the breaker
+                    # must not trip on a healthy swap) — propagate so the
+                    # service retries the whole request against the live
+                    # generation. Sources whose results we now abandon get
+                    # no outcome recorded; release any half-open trial slots
+                    # they hold or their breakers would deny later callers.
+                    for other in pending:
+                        ob = self._breaker(other)
+                        if ob is not None:
+                            ob.abandon_trial()
+                    raise
+                except Exception:  # noqa: BLE001 — a broken source degrades, never 500s
+                    self._degrade(degraded, f"candidate_error_{name}")
+                    if br is not None:
                         br.record_failure()
-                    else:
-                        br.abandon_trial()
-            except BatcherClosed:
-                # The request's generation snapshot lost a race with a
-                # hot-swap retirement. Not a source failure (the breaker
-                # must not trip on a healthy swap) — propagate so the
-                # service retries the whole request against the live
-                # generation. Sources whose results we now abandon get no
-                # outcome recorded; release any half-open trial slots they
-                # hold or their breakers would deny every later caller.
-                for other in futs:
-                    ob = self._breaker(other)
-                    if ob is not None:
-                        ob.abandon_trial()
-                raise
-            except Exception:  # noqa: BLE001 — a broken source degrades, never 500s
-                self._degrade(degraded, f"candidate_error_{name}")
-                if br is not None:
-                    br.record_failure()
+
+        frames: dict[str, pd.DataFrame] = {}
+        collect(futs, frames)
+        if bank_fut is not None:
+            from albedo_tpu.utils import events
+
+            fallback_names: list[str] = []
+            try:
+                # The bank's wait budget is capped at HALF the remaining
+                # stage budget (and its own timeout_s): a timed-out bank
+                # must leave the host fallback real time to answer, not a
+                # zero-budget collect that charges breaker failures to
+                # healthy sources.
+                remaining = max(0.0, eff_deadline - time.monotonic())
+                bank_frames = bank_fut.result(
+                    timeout=min(bank.timeout_s, remaining / 2.0)
+                )
+                frames.update(bank_frames)
+            except FutureTimeout:
+                bank_fut.cancel()
+                self._degrade(degraded, "bank_timeout")
+                events.retrieval_fallbacks.inc(reason="bank_timeout")
+                fallback_names = bank_names
+            except Exception:  # noqa: BLE001 — a broken bank degrades, never 500s
+                self._degrade(degraded, "bank_error")
+                events.retrieval_fallbacks.inc(reason="bank_error")
+                fallback_names = bank_names
+            if fallback_names:
+                # The degradation matrix's new edge: bank down -> the
+                # host-side per-source path (the exact fan-out this stage
+                # would have run without a bank), under whatever stage
+                # budget remains — breaker-guarded like any host source.
+                fb_futs: dict[str, Future] = {}
+                for name in fallback_names:
+                    rec = bank.fallbacks.get(name) or all_sources.get(name)
+                    if rec is None:
+                        continue
+                    br = self._breaker(name)
+                    if br is not None and not br.allow():
+                        self._degrade(degraded, f"breaker_open_{name}")
+                        continue
+                    fb_futs[name] = self._pool.submit(call_source, name, rec)
+                collect(fb_futs, frames)
         return frames
 
     def _rank(self, candidates: pd.DataFrame) -> pd.DataFrame:

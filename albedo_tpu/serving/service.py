@@ -106,6 +106,7 @@ class RecommendationService:
         warm: bool = False,
         breaker_config=None,
         breakers_enabled: bool = True,
+        bank_stage=None,  # retrieval.stage.BankStage — fused candidate stage
     ):
         self.matrix = matrix
         self.repo_info = repo_info if repo_info is not None else pd.DataFrame()
@@ -157,8 +158,9 @@ class RecommendationService:
 
         self.pipeline: TwoStagePipeline | None = None
         self._pipeline_owns_als = False
-        if recommenders:
-            sources = dict(recommenders)
+        self.bank_stage = bank_stage
+        if recommenders or bank_stage is not None:
+            sources = dict(recommenders or {})
             # The live ALS source rides each ModelGeneration and joins the
             # fan-out per request (pipeline extra_sources) — unless the
             # caller registered an "als" source explicitly, which then wins.
@@ -166,6 +168,7 @@ class RecommendationService:
             self.pipeline = TwoStagePipeline(
                 sources, ranker=ranker, deadlines=deadlines, metrics=self.metrics,
                 breaker_config=breaker_config, breakers_enabled=breakers_enabled,
+                bank_stage=bank_stage,
             )
 
         # Retired generations' batchers that have not been stopped yet: the
@@ -185,6 +188,12 @@ class RecommendationService:
         self._max_generation = self._generation.number
 
     # ------------------------------------------------- generation plumbing
+
+    @property
+    def exclude_table(self) -> np.ndarray | None:
+        """The device-exclusion source table (host copy) — shared with the
+        retrieval bank so seen-item exclusion has ONE definition."""
+        return self._exclude_table
 
     @property
     def generation(self) -> ModelGeneration:
@@ -316,6 +325,8 @@ class RecommendationService:
                 self.pipeline.breaker_states() if self.pipeline is not None else {}
             ),
         }
+        if self.bank_stage is not None:
+            report["retrieval_bank"] = self.bank_stage.snapshot()
         if self.cache is not None:
             report["cache"] = self.cache.stats()
         return ready, report

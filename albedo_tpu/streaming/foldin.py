@@ -143,6 +143,21 @@ class FoldInEngine:
         # the per-length rung_cap() below.
         self.rung_cap_entries = self.rung_cap(1)
         self.rung_capped = 0  # dispatches shrunk below max_batch by the cap
+        # Retrieval-bank overlay subscribers: (bank, source) pairs that
+        # receive every successfully folded user row (ROADMAP item 5's
+        # streaming hook — fresh rows land in the serving bank the moment
+        # the watchdog clears them, no republish cycle in between).
+        self._bank_subscribers: list[tuple] = []
+
+    def attach_bank(self, bank, source: str = "als") -> None:
+        """Subscribe a retrieval bank's ``user_rows`` source to this
+        engine's folded rows (``fold_in`` must then be called with
+        ``user_idx`` so the rows have addresses). ``bank`` is anything with
+        ``publish_user_rows`` — in a serving process attach the
+        ``BankStage``, not a bank object: the stage forwards to whichever
+        generation is currently promoted, so a bank hot-swap can't strand
+        the subscription on retired tables."""
+        self._bank_subscribers.append((bank, source))
 
     def rung_cap(self, length: int) -> int:
         """Budgeted ``bucket * length`` cap for rungs of this padded length
@@ -213,7 +228,9 @@ class FoldInEngine:
     # ----------------------------------------------------------------- solve
 
     def fold_in(
-        self, rows: list[tuple[np.ndarray, np.ndarray]]
+        self,
+        rows: list[tuple[np.ndarray, np.ndarray]],
+        user_idx: np.ndarray | None = None,
     ) -> np.ndarray:
         """Solve the given user rows against the frozen item factors.
 
@@ -222,7 +239,10 @@ class FoldInEngine:
         concern — a user whose every star was tombstoned keeps their OLD
         factors, matching the training path, where a row in no bucket lands
         nothing (see ``models.als._landing_perm``). Returns ``(len(rows),
-        rank)`` float32 factors.
+        rank)`` float32 factors. ``user_idx`` (dense user indices, aligned
+        with ``rows``) additionally publishes the solved rows into every
+        attached retrieval bank (:meth:`attach_bank`) — the streaming
+        overlay lands in the serving bank the moment the watchdog clears it.
         """
         if not rows:
             return np.zeros((0, self.rank), dtype=np.float32)
@@ -282,6 +302,13 @@ class FoldInEngine:
             chunk = rows[i:i + take]
             out[i:i + len(chunk)] = self._solve_chunk(chunk)
             i += take
+        if self._bank_subscribers and user_idx is not None:
+            # Only after EVERY chunk passed the watchdog: a diverged batch
+            # raised above and nothing reached the serving bank (the same
+            # nothing-publishes contract the stream generation write keeps).
+            idx = np.asarray(user_idx, dtype=np.int64)
+            for bank, source in self._bank_subscribers:
+                bank.publish_user_rows(source, idx, out)
         return out
 
     def _solve_chunk(self, chunk: list[tuple[np.ndarray, np.ndarray]]) -> np.ndarray:

@@ -378,7 +378,11 @@ def run_stream(ctx, args, opts) -> dict:
             batches_before = state.engine.batches_run
             f0 = time.perf_counter()
             if rows:
-                state.uf[np.asarray(t_idx, dtype=np.int64)] = state.engine.fold_in(rows)
+                # user_idx rides along so any attached retrieval bank
+                # (FoldInEngine.attach_bank) receives the fresh rows too.
+                state.uf[np.asarray(t_idx, dtype=np.int64)] = state.engine.fold_in(
+                    rows, user_idx=np.asarray(t_idx, dtype=np.int64)
+                )
             foldin_s = time.perf_counter() - f0
             events.foldin_users.inc(len(rows))
             record["foldin"] = {

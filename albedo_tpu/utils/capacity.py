@@ -537,6 +537,38 @@ def plan_foldin(
     )
 
 
+def plan_retrieval(
+    tables: "list[tuple[int, int]]",
+    excl_entries: int = 0,
+    generations: int = 1,
+    max_batch: int = 64,
+    item_block: int = 4096,
+    k: int = 64,
+) -> CapacityPlan:
+    """Price ``generations`` resident retrieval-bank generations.
+
+    ``tables``: every table the bank pins — each source's (rows, dim)
+    embedding table plus its user-row query table when it has one. During a
+    bank hot-swap TWO generations are resident (the incumbent keeps serving
+    until the candidate's gates pass), which is what ``generations=2``
+    admits against. Transient: one query batch's gathered rows + the
+    blocked-MIPS working set (a (B, item_block) score block and the running
+    (B, k) top-k) for the widest table.
+    """
+    resident = sum(int(n) * int(d) * 4 for n, d in tables)
+    max_dim = max((int(d) for _, d in tables), default=0)
+    b = max(1, int(max_batch))
+    transient = b * max_dim * 4 + b * (int(item_block) + int(k)) * 4
+    return CapacityPlan(
+        workload="retrieval",
+        items={
+            "embedding_tables": resident * max(1, int(generations)),
+            "exclusion_table": int(excl_entries) * 4,
+            "transient_query": transient,
+        },
+    )
+
+
 def max_foldin_entries(
     rank: int, n_items: int, budget: int | None = None, length: int = 1
 ) -> int:

@@ -1,0 +1,72 @@
+"""Weakref-keyed device residency for host-owned matrices.
+
+The content/tfidf similarity searches keep their projected matrices as host
+arrays (picklable, artifact-friendly) and used to re-upload them on EVERY
+query call (``jnp.asarray(self.vectors)`` per ``more_like_this``) — a full
+host->device copy of the whole table per request. The ALS layer already
+solved this shape of problem with an id-keyed weakref cache
+(``models/als.py _matrix_cache``); this is the same pattern, generalized:
+one device copy per (owner object, host array), dropped automatically when
+the owner is garbage-collected.
+
+Keyed by ``id(owner)`` with a liveness check (a ``WeakKeyDictionary`` would
+need hashable owners; dataclasses holding ndarrays aren't), and
+``weakref.finalize`` evicts the owner's slots when it dies so long-lived
+processes rotating many models don't accumulate device memory. Within an
+owner, slots key on the HOST ARRAY's identity and hold a weakref to it —
+an id() reused by a different array after garbage collection can never
+serve a stale device copy. Sharing is therefore by object, not by value:
+the host fallback path and a retrieval-bank build that read the same array
+object hold ONE device copy between them.
+"""
+
+from __future__ import annotations
+
+import threading
+import weakref
+from typing import Any
+
+_CACHES: dict[int, tuple[weakref.ref, dict]] = {}
+_LOCK = threading.Lock()
+
+
+def owner_cache(owner: Any) -> dict:
+    """The per-owner slot dict (created on first use, evicted with the owner)."""
+    key = id(owner)
+    with _LOCK:
+        hit = _CACHES.get(key)
+        if hit is not None:
+            ref, cache = hit
+            if ref() is owner:
+                return cache
+        cache: dict = {}
+        _CACHES[key] = (weakref.ref(owner), cache)
+        weakref.finalize(owner, _CACHES.pop, key, None)
+        return cache
+
+
+def device_put_cached(owner: Any, host_array):
+    """Get-or-create the device copy of ``host_array`` under ``owner``.
+
+    The upload runs at most once per (owner, array object) lifetime.
+    Identity-keyed on purpose — the stores treat artifacts as immutable, so
+    a mutated-in-place table must be replaced, not edited, to be re-uploaded
+    (the overlay paths that DO edit in place manage their own device state).
+    """
+    cache = owner_cache(owner)
+    # Prune slots whose host array died: an owner that replaces its table
+    # (a refit on a live object) must not keep the OLD device copy pinned
+    # until the owner itself dies.
+    for slot in [s for s, (ref, _) in cache.items() if ref() is None]:
+        del cache[slot]
+    slot = id(host_array)
+    hit = cache.get(slot)
+    if hit is not None:
+        ref, dev = hit
+        if ref() is host_array:
+            return dev
+    import jax.numpy as jnp
+
+    dev = jnp.asarray(host_array)
+    cache[slot] = (weakref.ref(host_array), dev)
+    return dev

@@ -217,6 +217,11 @@ STREAM_PUBLISHES_TOTAL = "albedo_stream_publishes_total"
 CAPACITY_VERDICTS_TOTAL = "albedo_capacity_verdicts_total"
 MESH_DEGRADED_TOTAL = "albedo_mesh_degraded_total"
 
+# Retrieval bank (ROADMAP item 5).
+RETRIEVAL_QUERIES_TOTAL = "albedo_retrieval_queries_total"
+RETRIEVAL_FALLBACKS_TOTAL = "albedo_retrieval_fallbacks_total"
+RETRIEVAL_PROMOTIONS_TOTAL = "albedo_retrieval_promotions_total"
+
 METRIC_NAMES: frozenset = frozenset(
     v for k, v in list(globals().items())
     if k.isupper() and isinstance(v, str) and v.startswith("albedo_")
@@ -346,4 +351,22 @@ mesh_degraded = global_counter(
     MESH_DEGRADED_TOTAL,
     "Mesh constructions that remeshed to fewer devices than requested "
     "(device loss or an injected mesh.devices fault).",
+)
+# The retrieval bank (ROADMAP item 5): fused candidate queries per source,
+# bank-failure fallbacks to the host fan-out, and bank generation swaps.
+retrieval_queries = global_counter(
+    RETRIEVAL_QUERIES_TOTAL,
+    "User rows answered by the device-resident retrieval bank, by source.",
+    ("source",),
+)
+retrieval_fallbacks = global_counter(
+    RETRIEVAL_FALLBACKS_TOTAL,
+    "Bank-backed candidate stages that fell back to the host-side "
+    "per-source fan-out, by reason (bank_timeout/bank_error).",
+    ("reason",),
+)
+retrieval_promotions = global_counter(
+    RETRIEVAL_PROMOTIONS_TOTAL,
+    "Retrieval-bank generation swaps, by outcome (promoted/rejected).",
+    ("outcome",),
 )

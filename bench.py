@@ -1457,6 +1457,231 @@ def foldin_bench() -> dict:
     }
 
 
+def retrieval_bench() -> dict:
+    """The `retrieval` scenario: the bank-backed fused candidate stage vs
+    the threaded per-source fan-out (ROADMAP item 5's acceptance record).
+
+    Both arms run the SAME `TwoStagePipeline` over the SAME sources (als +
+    content + tfidf) — arm A fans out one host thread per source, arm B
+    answers every source from the device-resident retrieval bank in one
+    fused gather -> GEMM -> top-k dispatch. A **candidate parity gate**
+    runs first: for every registered source, bank top-k over the probe
+    users must match the host-side recommender's top-k (scores within
+    1e-5, sets equal modulo score ties) or the bench fails. Then
+    interleaved closed-loop trials at `concurrency` clients with median
+    reporting (the bench-box throttling policy). The record carries
+    sustained candidate rps, measured p50/p99, the speedup, and achieved
+    GB/s against the bytes the MIPS pass scans per request. Env knobs:
+    ALBEDO_RETRIEVAL_USERS/ITEMS/CONCURRENCY/DURATION/TRIALS/K.
+    """
+    import statistics
+    import threading as _threading
+
+    from albedo_tpu.datasets import synthetic_tables
+    from albedo_tpu.models.als import ImplicitALS
+    from albedo_tpu.models.word2vec import Word2Vec
+    from albedo_tpu.recommenders import (
+        ALSRecommender,
+        ContentRecommender,
+        EmbeddingSearchBackend,
+        TfidfRecommender,
+        TfidfSimilaritySearch,
+    )
+    from albedo_tpu.retrieval import BankStage, RetrievalBank, candidate_parity
+    from albedo_tpu.retrieval.parity import frame_to_pairs
+    from albedo_tpu.serving.pipeline import TwoStagePipeline
+
+    n_users = int(os.environ.get("ALBEDO_RETRIEVAL_USERS", "3000"))
+    n_items = int(os.environ.get("ALBEDO_RETRIEVAL_ITEMS", "2000"))
+    concurrency = int(os.environ.get("ALBEDO_RETRIEVAL_CONCURRENCY", "64"))
+    duration_s = float(os.environ.get("ALBEDO_RETRIEVAL_DURATION", "3"))
+    trials = int(os.environ.get("ALBEDO_RETRIEVAL_TRIALS", "3"))
+    k = int(os.environ.get("ALBEDO_RETRIEVAL_K", "30"))
+
+    tables = synthetic_tables(
+        n_users=n_users, n_items=n_items, mean_stars=10, seed=42
+    )
+    matrix = tables.star_matrix()
+    model = ImplicitALS(rank=16, max_iter=3, seed=0).fit(matrix)
+    als = ALSRecommender(model, matrix, exclude_seen=True, top_k=k)
+    # A small trained w2v over the repo text corpus feeds the content
+    # embeddings (the sync_index artifact's table, bench-sized).
+    corpus = [
+        str(t).replace(",", " ").split()
+        for t in (
+            tables.repo_info["repo_name"].fillna("")
+            + " " + tables.repo_info["repo_description"].fillna("")
+            + " " + tables.repo_info["repo_language"].fillna("")
+        )
+    ]
+    w2v = Word2Vec(dim=16, min_count=2, max_iter=2, subsample=0.0).fit_corpus(corpus)
+    backend = EmbeddingSearchBackend(tables.repo_info, w2v)
+    content = ContentRecommender(backend, tables.starring, top_k=k)
+    search = TfidfSimilaritySearch(min_df=2).fit(tables.repo_info)
+    tfidf = TfidfRecommender(search, tables.starring, top_k=k)
+    host_sources = {"als": als, "content": content, "tfidf": tfidf}
+
+    from albedo_tpu.datasets.ragged import padded_rows
+
+    indptr, cols, _ = matrix.csr()
+    exclude_table = padded_rows(indptr, cols, np.arange(matrix.n_users))
+    bank = RetrievalBank()
+    bank.register(als.bank_registration())
+    bank.register(content.bank_registration())
+    bank.register(tfidf.bank_registration())
+    bank.build(matrix=matrix, exclude_table=exclude_table)
+    # timeout_s generous like the stage deadline below: under closed-loop
+    # c=64 the bank task's POOL QUEUE wait counts against its budget, and a
+    # premature bank_timeout would fail run_load's zero-degradation gate.
+    stage = BankStage(
+        bank, matrix, fallbacks=host_sources, top_k=k, timeout_s=60.0
+    )
+
+    # --- the candidate parity gate (before any timing) -------------------
+    rng = np.random.default_rng(7)
+    probe = rng.choice(matrix.n_users, size=min(32, matrix.n_users), replace=False)
+    parity_checked = 0
+    for du in probe:
+        uid = int(matrix.user_ids[int(du)])
+        frames = stage.query_frames(uid, k=k, exclude_seen=True)
+        for name, rec in host_sources.items():
+            host_frame = rec.recommend_for_users(np.array([uid]))
+            report = candidate_parity(
+                frame_to_pairs(host_frame, uid),
+                (
+                    frames[name]["repo_id"].to_numpy(np.int64),
+                    frames[name]["score"].to_numpy(np.float64),
+                ),
+            )
+            if not report["ok"]:
+                fail(
+                    "retrieval_parity",
+                    f"source {name} user {uid}: {report.get('why')}", **report,
+                )
+            parity_checked += 1
+
+    # Generous stage deadline for BOTH arms: at c=64 the threaded fan-out
+    # queues far past the serving default's 2 s budget — the bench measures
+    # how slow that path honestly is, rather than letting degradation drop
+    # sources and fake a faster fan-out (run_load fails on ANY degraded
+    # answer, so every timed request carries the full candidate set).
+    from albedo_tpu.serving.pipeline import StageDeadlines
+
+    deadlines = StageDeadlines(candidates_s=60.0)
+    fanout = TwoStagePipeline(dict(host_sources), deadlines=deadlines)
+    banked = TwoStagePipeline(
+        dict(host_sources), deadlines=deadlines, bank_stage=stage
+    )
+
+    def run_load(pipe, tag: str) -> dict:
+        latencies: list[float] = []
+        lat_lock = _threading.Lock()
+        stop = _threading.Event()
+        counts = [0] * concurrency
+        errors: list[str] = []
+
+        def client(ci: int) -> None:
+            rng = np.random.default_rng(1000 + ci)
+            local: list[float] = []
+            try:
+                while not stop.is_set():
+                    uid = int(matrix.user_ids[int(rng.integers(0, matrix.n_users))])
+                    t0 = time.perf_counter()
+                    try:
+                        out = pipe.recommend(uid, k)
+                    except Exception as e:  # noqa: BLE001
+                        errors.append(f"{tag}: {e!r}")
+                        return
+                    local.append(time.perf_counter() - t0)
+                    if out.get("degraded"):
+                        errors.append(f"{tag}: unexpected degradation {out['degraded']}")
+                        return
+                    counts[ci] += 1
+            finally:
+                with lat_lock:
+                    latencies.extend(local)
+
+        threads = [
+            _threading.Thread(target=client, args=(ci,), daemon=True)
+            for ci in range(concurrency)
+        ]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        time.sleep(duration_s)
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+        elapsed = time.perf_counter() - t0
+        if errors:
+            fail("retrieval_load", f"{len(errors)} client error(s); first: {errors[0]}")
+        lat_ms = sorted(x * 1e3 for x in latencies)
+
+        def pct(p: float) -> float:
+            if not lat_ms:
+                return 0.0
+            return lat_ms[min(len(lat_ms) - 1, int(p * len(lat_ms)))]
+
+        return {
+            "requests": sum(counts),
+            "rps": round(sum(counts) / elapsed, 1),
+            "p50_ms": round(pct(0.50), 3),
+            "p99_ms": round(pct(0.99), 3),
+            "mean_ms": round(statistics.fmean(lat_ms), 3) if lat_ms else 0.0,
+        }
+
+    # Warm both arms, then interleave A/B with median selection.
+    warm_uid = int(matrix.user_ids[0])
+    fanout.recommend(warm_uid, k)
+    banked.recommend(warm_uid, k)
+    fan_trials, bank_trials = [], []
+    for _ in range(max(1, trials)):
+        fan_trials.append(run_load(fanout, "fanout"))
+        bank_trials.append(run_load(banked, "bank"))
+    fan = sorted(fan_trials, key=lambda r: r["rps"])[len(fan_trials) // 2]
+    bnk = sorted(bank_trials, key=lambda r: r["rps"])[len(bank_trials) // 2]
+    fanout.close()
+    banked.close()
+
+    # Achieved GB/s: the bytes the blocked MIPS pass scans per request —
+    # every source's full embedding table once (the GEMM reads it all).
+    bytes_per_query = sum(
+        int(s.vectors.shape[0]) * int(s.vectors.shape[1]) * 4
+        for s in bank.specs.values()
+    )
+    return {
+        "metric": "retrieval_candidates_rps",
+        "unit": "fused candidate requests/s at c="
+                f"{concurrency} (median of {max(1, trials)} interleaved trials)",
+        "value": bnk["rps"],
+        "concurrency": concurrency,
+        "duration_s": duration_s,
+        "k": k,
+        "n_users": n_users,
+        "n_items": n_items,
+        "parity_checked": parity_checked,
+        "sources": {
+            name: {
+                "rows": int(s.vectors.shape[0]),
+                "dim": int(s.vectors.shape[1]),
+                "calibration_scale": bank.calibration[name]["scale"],
+            }
+            for name, s in bank.specs.items()
+        },
+        "bank": bnk,
+        "fanout": fan,
+        "speedup_vs_fanout": round(bnk["rps"] / max(fan["rps"], 1e-9), 2),
+        "achieved_gbps": round(
+            bnk["rps"] * bytes_per_query / 1e9, 3
+        ),
+        "bytes_scanned_per_query": bytes_per_query,
+        "trials": {
+            "fanout_rps": [r["rps"] for r in fan_trials],
+            "bank_rps": [r["rps"] for r in bank_trials],
+        },
+    }
+
+
 def capacity_bench() -> dict:
     """The `capacity` scenario: chunked-fallback overhead vs the resident
     path.
@@ -1745,6 +1970,7 @@ SCENARIOS = {
     "foldin": foldin_bench,
     "capacity": capacity_bench,
     "scale": scale_bench,
+    "retrieval": retrieval_bench,
 }
 
 

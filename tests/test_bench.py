@@ -116,6 +116,28 @@ def test_watchdog_partial_status_field():
 
 
 @pytest.mark.slow
+def test_retrieval_scenario_record_shape(monkeypatch):
+    """Micro-size run of the `retrieval` scenario: the parity gate must
+    actually run, and the record must carry both arms' latencies, the
+    speedup, and the bytes-scanned GB/s model (the RETRIEVAL_r01 shape)."""
+    monkeypatch.setenv("ALBEDO_RETRIEVAL_USERS", "300")
+    monkeypatch.setenv("ALBEDO_RETRIEVAL_ITEMS", "200")
+    monkeypatch.setenv("ALBEDO_RETRIEVAL_CONCURRENCY", "8")
+    monkeypatch.setenv("ALBEDO_RETRIEVAL_DURATION", "0.5")
+    monkeypatch.setenv("ALBEDO_RETRIEVAL_TRIALS", "1")
+    rec = bench.retrieval_bench()
+    assert rec["metric"] == "retrieval_candidates_rps"
+    assert rec["parity_checked"] > 0
+    assert set(rec["sources"]) == {"als", "content", "tfidf"}
+    for arm in ("bank", "fanout"):
+        assert rec[arm]["rps"] > 0 and rec[arm]["p99_ms"] >= rec[arm]["p50_ms"]
+    assert rec["speedup_vs_fanout"] > 0
+    assert rec["bytes_scanned_per_query"] == sum(
+        s["rows"] * s["dim"] * 4 for s in rec["sources"].values()
+    )
+
+
+@pytest.mark.slow
 def test_scale_scenario_record_shape(monkeypatch, tmp_path):
     """Micro-size run of the `scale` weak-scaling scenario: the record must
     carry the full curve (per-sweep wall-clock, GB/s per chip, efficiency),

@@ -59,5 +59,6 @@ def test_known_sites_are_present():
         "stream.ingest", "stream.foldin", "stream.drift",
         "capacity.admit", "mesh.devices", "als.chunked",
         "als.shard.gather", "als.shard.stream",
+        "retrieval.build", "retrieval.query",
     ):
         assert site in code, f"expected fault site {site!r} not found in code"

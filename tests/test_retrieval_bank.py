@@ -1,0 +1,382 @@
+"""The retrieval bank: build/calibration/versioning, blocked-MIPS parity
+with every host-side score path (single-device AND mesh-sharded), seen-item
+exclusion through the shared table, the streaming overlay hook, capacity
+admission, and generation promotion gates."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from albedo_tpu.datasets import synthetic_tables  # noqa: E402
+from albedo_tpu.datasets.ragged import padded_rows  # noqa: E402
+from albedo_tpu.models.als import ImplicitALS  # noqa: E402
+from albedo_tpu.recommenders import (  # noqa: E402
+    ALSRecommender,
+    ContentRecommender,
+    EmbeddingSearchBackend,
+    TfidfRecommender,
+    TfidfSimilaritySearch,
+)
+from albedo_tpu.retrieval import (  # noqa: E402
+    BankSourceSpec,
+    BankStage,
+    RetrievalBank,
+    candidate_parity,
+)
+from albedo_tpu.retrieval.parity import frame_to_pairs  # noqa: E402
+from albedo_tpu.utils import capacity, events, faults  # noqa: E402
+
+K = 12
+
+
+class _W2VStub:
+    """Deterministic word2vec stand-in: hash words to fixed unit vectors —
+    the content backend only needs ``document_vector``."""
+
+    dim = 12
+
+    def document_vector(self, words):
+        if not words:
+            return np.zeros(self.dim, dtype=np.float32)
+        rows = []
+        for w in words:
+            rng = np.random.default_rng(abs(hash(w)) % (2**32))
+            rows.append(rng.normal(size=self.dim))
+        v = np.mean(rows, axis=0)
+        return (v / max(np.linalg.norm(v), 1e-9)).astype(np.float32)
+
+
+@pytest.fixture(scope="module")
+def world():
+    tables = synthetic_tables(n_users=150, n_items=110, mean_stars=8, seed=3)
+    matrix = tables.star_matrix()
+    model = ImplicitALS(rank=8, max_iter=3, seed=0).fit(matrix)
+    als = ALSRecommender(model, matrix, exclude_seen=True, top_k=K)
+    backend = EmbeddingSearchBackend(tables.repo_info, _W2VStub())
+    content = ContentRecommender(backend, tables.starring, top_k=K)
+    search = TfidfSimilaritySearch(min_df=1).fit(tables.repo_info)
+    tfidf = TfidfRecommender(search, tables.starring, top_k=K)
+    indptr, cols, _ = matrix.csr()
+    excl = padded_rows(indptr, cols, np.arange(matrix.n_users))
+    return tables, matrix, model, als, content, tfidf, search, excl
+
+
+def _built_bank(world, mesh=None):
+    _tables, matrix, _model, als, content, tfidf, _search, excl = world
+    bank = RetrievalBank()
+    bank.register(als.bank_registration())
+    bank.register(content.bank_registration())
+    bank.register(tfidf.bank_registration())
+    bank.build(matrix=matrix, exclude_table=excl, mesh=mesh)
+    return bank
+
+
+@pytest.fixture(scope="module")
+def bank(world):
+    return _built_bank(world)
+
+
+def _bank_pairs(bank, name, vals, idx, row):
+    ok = (idx[row] >= 0) & np.isfinite(vals[row])
+    return (
+        bank.specs[name].item_ids[idx[row][ok]],
+        vals[row][ok].astype(np.float64),
+    )
+
+
+# --- parity against every host-side score path --------------------------------
+
+
+def test_bank_matches_host_paths_per_source(world, bank):
+    _tables, matrix, _model, als, content, tfidf, _search, _excl = world
+    users = np.arange(12, dtype=np.int64)
+    raw = matrix.user_ids[users]
+    out = bank.query(users, K, raw_user_ids=raw, exclude_seen=True)
+    hosts = {
+        "als": als.recommend_for_users(raw),
+        "content": content.recommend_for_users(raw),
+        "tfidf": tfidf.recommend_for_users(raw),
+    }
+    for name, frame in hosts.items():
+        vals, idx = out[name]
+        for row, uid in enumerate(raw):
+            report = candidate_parity(
+                frame_to_pairs(frame, int(uid)),
+                _bank_pairs(bank, name, vals, idx, row),
+            )
+            assert report["ok"], (name, int(uid), report)
+
+
+def test_exclusion_actually_excludes_seen_items(world, bank):
+    _tables, matrix, _model, *_ = world
+    indptr, cols, _ = matrix.csr()
+    users = np.arange(8, dtype=np.int64)
+    vals, idx = bank.query(users, K, exclude_seen=True, sources=("als",))["als"]
+    for row, du in enumerate(users):
+        seen = set(cols[indptr[du]:indptr[du + 1]].tolist())
+        got = set(idx[row][idx[row] >= 0].tolist())
+        assert not (seen & got), f"user {du} was served already-seen items"
+
+
+def test_exclude_seen_without_table_refuses(world):
+    _tables, matrix, _model, als, *_ = world
+    bank = RetrievalBank()
+    bank.register(als.bank_registration())
+    bank.build(matrix=matrix)  # no exclude_table
+    with pytest.raises(ValueError, match="exclude_table"):
+        bank.query(np.arange(2), 5, exclude_seen=True)
+
+
+def test_unknown_users_get_no_user_row_candidates(world, bank):
+    _tables, matrix, *_ = world
+    vals, idx = bank.query(np.array([-1, 0]), 5, sources=("als",))["als"]
+    assert np.all(idx[0] == -1) and not np.any(np.isfinite(vals[0]))
+    assert np.any(idx[1] >= 0)
+
+
+def test_item_mean_query_without_raw_ids_refuses(world, bank):
+    with pytest.raises(ValueError, match="raw_user_ids"):
+        bank.query(np.array([0]), 5, sources=("content",))
+
+
+def test_sharded_bank_matches_single_device(world, bank):
+    from albedo_tpu.parallel.mesh import make_mesh
+
+    sharded = _built_bank(world, mesh=make_mesh())
+    users = np.arange(10, dtype=np.int64)
+    raw = world[1].user_ids[users]
+    for kwargs in ({"exclude_seen": True}, {"exclude_seen": False}):
+        a = bank.query(users, K, raw_user_ids=raw, **kwargs)
+        b = sharded.query(users, K, raw_user_ids=raw, **kwargs)
+        for name in bank.source_names:
+            va, _ia = a[name]
+            vb, _ib = b[name]
+            mask = np.isfinite(va) & np.isfinite(vb)
+            assert np.allclose(va[mask], vb[mask], atol=1e-5), (name, kwargs)
+            assert np.array_equal(np.isfinite(va), np.isfinite(vb))
+
+
+# --- build semantics ----------------------------------------------------------
+
+
+def test_calibration_recorded_per_source(bank):
+    for name in bank.source_names:
+        cal = bank.calibration[name]
+        assert cal["scale"] > 0
+        assert cal["row_norm_max"] >= cal["row_norm_mean"] >= 0
+    # Cosine sources' top-1 sits at ~1.0 already: scale ~1.
+    assert bank.calibration["content"]["scale"] == pytest.approx(1.0, abs=0.2)
+
+
+def test_build_fires_fault_site_and_counts_admission(world):
+    _tables, matrix, _model, als, *_ = world
+    faults.arm("retrieval.build", "error", at=1)
+    bank = RetrievalBank()
+    bank.register(als.bank_registration())
+    with pytest.raises(faults.FaultInjected):
+        bank.build(matrix=matrix)
+    faults.reset()
+    bank.build(matrix=matrix)
+    assert bank.admission is not None and bank.admission.verdict == "fit"
+    assert events.capacity_verdicts.value(verdict="fit", workload="retrieval") >= 1
+
+
+def test_capacity_refusal_before_any_upload(world, monkeypatch):
+    _tables, matrix, _model, als, *_ = world
+    bank = RetrievalBank()
+    bank.register(als.bank_registration())
+    with pytest.raises(capacity.CapacityExceeded):
+        bank.build(matrix=matrix, budget=1024)
+    assert not bank._built
+
+
+def test_plan_retrieval_prices_generations_and_tables():
+    one = capacity.plan_retrieval([(1000, 64), (500, 64)], generations=1)
+    two = capacity.plan_retrieval([(1000, 64), (500, 64)], generations=2)
+    assert one.items["embedding_tables"] == 1500 * 64 * 4
+    assert two.items["embedding_tables"] == 2 * one.items["embedding_tables"]
+    assert capacity.plan_retrieval([(10, 4)], excl_entries=100).items[
+        "exclusion_table"
+    ] == 400
+
+
+def test_version_roundtrip_and_sealed_artifact(world, bank):
+    from albedo_tpu.datasets import artifacts as store
+
+    _tables, matrix, *_ = world
+    path = bank.save("test-retrievalBank-v1.pkl", lineage={"tag": "t"})
+    assert store.verify_manifest(path) is True
+    meta = store.read_meta(path)
+    assert meta["bank"]["version"] == bank.version
+    assert set(meta["bank"]["sources"]) == set(bank.source_names)
+    loaded = RetrievalBank.load("test-retrievalBank-v1.pkl")
+    loaded.build(matrix=matrix)
+    assert loaded.version == bank.version
+
+
+# --- scenario diversity -------------------------------------------------------
+
+
+def test_similar_repos_by_example(world, bank):
+    _tables, _matrix, _model, _als, _content, _tfidf, search, _excl = world
+    query_repo = int(search.doc_ids[0])
+    (ids, scores), = bank.query_similar("tfidf", [np.array([query_repo])], 5)
+    assert query_repo not in ids  # MLT never returns the query itself
+    assert np.all(np.diff(scores) <= 1e-12)  # score-descending
+    # Cross-check against the host path.
+    (h_ids, h_scores), = search.similar_to_repos([np.array([query_repo])], 5)
+    report = candidate_parity((h_ids, h_scores), (ids, scores))
+    assert report["ok"], report
+
+
+def test_user_to_user_similarity_source(world):
+    _tables, matrix, model, *_ = world
+    uf = np.asarray(model.user_factors, np.float32)
+    bank = RetrievalBank()
+    bank.register(BankSourceSpec(
+        name="user_sim", kind="user_rows", vectors=uf,
+        item_ids=matrix.user_ids, user_vectors=uf,
+    ))
+    bank.build(matrix=matrix)
+    vals, idx = bank.query(np.array([5]), 3)["user_sim"]
+    assert idx[0][0] == 5  # a user's nearest neighbor is themself
+
+
+# --- the streaming overlay hook ----------------------------------------------
+
+
+def test_publish_user_rows_lands_in_next_query(world):
+    _tables, matrix, model, als, *_ = world
+    bank = RetrievalBank()
+    bank.register(als.bank_registration())
+    bank.build(matrix=matrix)
+    rng = np.random.default_rng(0)
+    fresh = rng.normal(size=(2, model.rank)).astype(np.float32)
+    gen = bank.publish_user_rows("als", np.array([0, 1]), fresh)
+    assert gen == 1
+    vals, idx = bank.query(np.array([0]), 5)["als"]
+    expected = fresh[0] @ np.asarray(model.item_factors, np.float32).T
+    top = np.sort(expected)[::-1][:5]
+    assert np.allclose(np.sort(vals[0])[::-1], top, atol=1e-5)
+
+
+def test_overlay_never_mutates_the_registered_model(world):
+    """bank_registration registers a no-copy view of the model's factors;
+    the first publish must copy — overlay rows must never rewrite the
+    trained artifact under the model's other holders."""
+    _tables, matrix, model, als, *_ = world
+    before = np.array(model.user_factors, dtype=np.float32, copy=True)
+    bank = RetrievalBank()
+    bank.register(als.bank_registration())
+    bank.build(matrix=matrix)
+    bank.publish_user_rows(
+        "als", np.array([0]),
+        np.full((1, model.rank), 123.0, dtype=np.float32),
+    )
+    assert np.array_equal(np.asarray(model.user_factors, np.float32), before)
+    assert bank.specs["als"].user_vectors[0, 0] == 123.0
+
+
+def test_foldin_engine_publishes_into_attached_bank(world):
+    from albedo_tpu.streaming.foldin import FoldInEngine
+
+    _tables, matrix, model, als, *_ = world
+    bank = RetrievalBank()
+    bank.register(als.bank_registration())
+    bank.build(matrix=matrix)
+    engine = FoldInEngine(model)
+    engine.attach_bank(bank, source="als")
+    indptr, cols, vals_ = matrix.csr()
+    du = 3
+    row_idx = cols[indptr[du]:indptr[du + 1]].astype(np.int32)
+    row_val = vals_[indptr[du]:indptr[du + 1]].astype(np.float32)
+    solved = engine.fold_in(
+        [(row_idx, row_val)], user_idx=np.array([du], dtype=np.int64)
+    )
+    assert bank.overlay_generation == 1
+    # The bank's user table now carries the freshly solved row.
+    assert np.allclose(bank.specs["als"].user_vectors[du], solved[0], atol=1e-6)
+
+
+def test_diverged_foldin_publishes_nothing(world):
+    from albedo_tpu.streaming.foldin import FoldInDiverged, FoldInEngine
+
+    _tables, matrix, model, als, *_ = world
+    bank = RetrievalBank()
+    bank.register(als.bank_registration())
+    bank.build(matrix=matrix)
+    engine = FoldInEngine(model, max_rms=1e-30)  # every solve "diverges"
+    engine.attach_bank(bank, source="als")
+    indptr, cols, vals_ = matrix.csr()
+    row_idx = cols[indptr[0]:indptr[1]].astype(np.int32)
+    row_val = vals_[indptr[0]:indptr[1]].astype(np.float32)
+    with pytest.raises(FoldInDiverged):
+        engine.fold_in([(row_idx, row_val)], user_idx=np.array([0]))
+    assert bank.overlay_generation == 0  # nothing landed
+
+
+# --- generation promotion -----------------------------------------------------
+
+
+def test_stage_reload_gates(world, bank, monkeypatch):
+    _tables, matrix, _model, als, content, tfidf, _search, _excl = world
+    stage = BankStage(
+        _built_bank(world), matrix,
+        fallbacks={"als": als, "content": content, "tfidf": tfidf}, top_k=K,
+    )
+    bank.save("test-bankgen.pkl")
+    report = stage.reload("test-bankgen.pkl")
+    assert report["outcome"] == "promoted" and stage.generation == 2
+    assert events.retrieval_promotions.value(outcome="promoted") == 1
+    # Promoted candidate must keep answering item_mean sources (providers
+    # are inherited from the incumbent).
+    frames = stage.query_frames(int(matrix.user_ids[0]), k=5)
+    assert set(frames) == set(stage.source_names)
+
+    # Missing manifest -> manifest gate.
+    report = stage.reload("no-such-bank.pkl")
+    assert report == {
+        "outcome": "rejected", "gate": "manifest", "why": report["why"],
+    }
+
+    # A candidate that drops a source is a restart, not a swap.
+    small = RetrievalBank()
+    small.register(als.bank_registration())
+    small.build(matrix=matrix)
+    small.save("test-bankgen-small.pkl")
+    report = stage.reload("test-bankgen-small.pkl")
+    assert report["outcome"] == "rejected" and report["gate"] == "invariants"
+
+    # Capacity refusal is a recorded rejection, not a crash.
+    monkeypatch.setenv("ALBEDO_DEVICE_MEM_BYTES", "4096")
+    report = stage.reload("test-bankgen.pkl")
+    monkeypatch.delenv("ALBEDO_DEVICE_MEM_BYTES")
+    assert report["outcome"] == "rejected" and report["gate"] == "capacity"
+    assert events.retrieval_promotions.value(outcome="rejected") == 3
+
+
+def test_unstamped_bank_rejected_when_stamp_required(world, bank, tmp_path):
+    from albedo_tpu.datasets import artifacts as store
+
+    _tables, matrix, *_ = world
+    stage = BankStage(_built_bank(world), matrix, top_k=K)
+    path = bank.save("test-bank-nostamp.pkl")
+    store.meta_path(path).unlink()  # strip the stamp, keep the manifest
+    report = stage.reload("test-bank-nostamp.pkl", require_stamp=True)
+    assert report["outcome"] == "rejected" and report["gate"] == "stamp"
+
+
+# --- the shared device-residency cache ---------------------------------------
+
+
+def test_device_projection_cached_per_identity(world):
+    from albedo_tpu.utils.devcache import device_put_cached
+
+    _tables, _matrix, _model, _als, _content, _tfidf, search, _excl = world
+    a = search._device_matrix()
+    b = search._device_matrix()
+    assert a is b  # one upload per model identity
+    # The bank's build reuses the same device copy (owner + array shared).
+    c = device_put_cached(search, search.matrix)
+    assert c is a

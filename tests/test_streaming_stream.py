@@ -303,7 +303,7 @@ def test_failed_cycle_lands_in_the_journal(monkeypatch):
 
     from albedo_tpu.streaming.foldin import FoldInDiverged, FoldInEngine
 
-    def boom(self, rows):
+    def boom(self, rows, user_idx=None):
         raise FoldInDiverged(len(rows), {"nonfinite": 1, "max_abs": 0.0, "rms": 0.0})
 
     monkeypatch.setattr(FoldInEngine, "fold_in", boom)
