@@ -11,8 +11,8 @@ JOBS = popularity curation content train_als cv_als build_user_profile \
        run_pipeline datacheck run_stream build_bank
 
 .PHONY: $(JOBS) test test-all bench serve-bench datacheck-bench chaos \
-        chaos-serve chaos-stream stream stream-bench dryrun soak soak-smoke \
-        capacity-bench retrieval-bench lint lint-baseline
+        chaos-serve chaos-stream chaos-elastic stream stream-bench dryrun \
+        soak soak-smoke capacity-bench retrieval-bench lint lint-baseline
 
 $(JOBS):
 	$(PY) -m albedo_tpu.cli $@ $(ARGS)
@@ -91,6 +91,15 @@ soak:
 # under the chaos marker.
 soak-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_soak.py -q -m chaos
+
+# Elastic-operation chaos: mesh-portable checkpoint roundtrips, the
+# mid-fit device-loss remesh-resume drill, the degraded-mesh serving
+# (bank reshard/promote) parity checks, and the cross-mesh kill-resume
+# drill through the real CLI (8 virtual devices -> resume on 4). Runs the
+# WHOLE elastic suite (no marker filter): the in-process drills are the
+# tier-1 flavor, the chaos-marked CLI drill is the subprocess acceptance.
+chaos-elastic:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_elastic.py -q
 
 # Capacity scenario: chunked-fallback overhead vs the device-resident fit
 # (interleaved trials, medians — per the bench-box throttling policy).

@@ -151,6 +151,34 @@ class JobContext:
             3 if steps is None else int(steps),
         )
 
+    def mesh(self):
+        """The training mesh from ``--mesh-devices`` (None = single device),
+        built once per context. Fewer visible devices than requested remesh
+        down the degraded ladder (loudly, counted) — the same call path a
+        checkpointed sharded fit resumes through on a smaller slice."""
+        n = int(getattr(self.args, "mesh_devices", 0) or 0)
+        if n <= 0:
+            return None
+        if "mesh" not in self._cache:
+            from albedo_tpu.parallel.mesh import make_mesh
+
+            self._cache["mesh"] = make_mesh(n)
+        return self._cache["mesh"]
+
+    def mesh_opts(self) -> dict:
+        """Estimator kwargs for the mesh fit: ``--sharded`` maps auto ->
+        None (the admission ladder decides); ``--shard-mode`` passes
+        through. Empty when no mesh is configured."""
+        mesh = self.mesh()
+        if mesh is None:
+            return {}
+        sharded = getattr(self.args, "sharded", "auto") or "auto"
+        return dict(
+            mesh=mesh,
+            sharded=None if sharded == "auto" else sharded,
+            shard_mode=getattr(self.args, "shard_mode", "allgather") or "allgather",
+        )
+
     def checkpoint_opts(self) -> tuple[int, bool, int | None]:
         """(checkpoint_every, resume, keep_last) from the CLI flags;
         ``--keep-last 0`` means keep every step (maps to None)."""
@@ -167,7 +195,13 @@ class JobContext:
         iterations under ``checkpoint_dir/<tag>-<key>``, resumes from the
         newest readable step under ``--resume``, and converts SIGTERM/SIGINT
         into a checkpoint + :class:`~albedo_tpu.utils.checkpoint.Preempted`
-        clean exit (the CLI maps it to exit code 75)."""
+        clean exit (the CLI maps it to exit code 75).
+
+        A MESH estimator routes to the ELASTIC driver
+        (:func:`~albedo_tpu.parallel.elastic.elastic_sharded_fit`): the
+        same preemption/journal/retention contract, plus mesh-portable
+        sharded checkpoints (a fit checkpointed on 8 devices resumes on a
+        4/2/1-device rung) and mid-fit device-loss remesh-resume."""
         import shutil
 
         from albedo_tpu.settings import get_settings
@@ -191,6 +225,13 @@ class JobContext:
         watchdog = DivergenceWatchdog()
         try:
             with PreemptionHandler() as preemption:
+                if est.mesh is not None:
+                    from albedo_tpu.parallel.elastic import elastic_sharded_fit
+
+                    return elastic_sharded_fit(
+                        est, matrix, ckdir, every=every, keep_last=keep_last,
+                        preemption=preemption, watchdog=watchdog,
+                    )
                 return checkpointed_als_fit(
                     est, matrix, ckdir, every=every, keep_last=keep_last,
                     preemption=preemption, watchdog=watchdog,
@@ -234,7 +275,7 @@ class JobContext:
         def train():
             est = ImplicitALS(
                 rank=rank, reg_param=reg, alpha=alpha, max_iter=iters,
-                solver=solver, cg_steps=cg_steps,
+                solver=solver, cg_steps=cg_steps, **self.mesh_opts(),
             )
             every, _, _ = self.checkpoint_opts()
             if every > 0:

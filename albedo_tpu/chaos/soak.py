@@ -8,8 +8,11 @@ soak run:
 
 1. draws a deterministic fault schedule (``--soak-seed``) over the
    catalogued site inventory — every kind (error/ioerror/corrupt/delay/
-   kill/term/oom) appears at least once, placed where its effect is
-   observable;
+   kill/term/oom/loss) appears at least once, placed where its effect is
+   observable; the ``loss`` cycle is the DEVICE-LOSS cycle: its mesh leg
+   runs the elastic fit drill (a shard dies mid-sweep, the fit must
+   checkpoint -> remesh -> resume to parity) plus the degraded-serving
+   drill (a bank sealed at the full rung promotes onto the halved rung);
 2. runs ``--soak-cycles`` full loops, each: a **mesh boot** (degraded-remesh
    ladder), the **offline pipeline** (ingest -> train_als -> canary publish,
    a real CLI subprocess so kill/term faults genuinely kill something), a
@@ -117,13 +120,19 @@ MESH_FAULTS = (
 # are subprocess-only (their evidence is the exit code): term at
 # checkpoint.save on the FIRST cycle (the only one guaranteed to train from
 # scratch, where the preemption handler is installed -> exit 75), kill at the
-# stage wrapper, which fires on every cycle -> exit 137.
+# stage wrapper, which fires on every cycle -> exit 137. `loss` is the
+# ELASTIC surface: its cycle's mesh leg swaps the plain sharded drill for
+# the elastic one (`_elastic_fit_drill` — the injected device loss must be
+# survived via checkpoint -> remesh -> resume, or fail CLEANLY as MeshLost
+# on a 1-device rung), plus the degraded-serving drill (a bank sealed at
+# the full rung promotes onto the halved rung through the real gates).
 KIND_EVIDENCE = {
     "error": ("mesh", "mesh.devices", "error"),
     "delay": ("mesh", "mesh.devices", "delay"),
     "ioerror": ("serve", "reload.load", "ioerror"),
     "corrupt": ("serve", "reload.load", "corrupt"),
     "oom": ("serve", "capacity.admit", "oom"),
+    "loss": ("mesh", "als.shard.collective", "loss"),
     "term": ("pipeline", "checkpoint.save", "term"),
     "kill": ("pipeline", "pipeline.stage.train_als", "kill"),
 }
@@ -165,6 +174,11 @@ def build_schedule(
             cycle, at = 0, 2  # checkpoint 2 of the from-scratch training fit
         elif kind == "kill":
             cycle, at = 1, 1
+        elif kind == "loss":
+            # The device-loss cycle: pinned to cycle 1 so the 2-cycle smoke
+            # always runs it, and kept OFF the last cycle (which pins the
+            # plain sharded drill's als.shard.gather coverage).
+            cycle, at = 0, 1
         else:
             cycle, at = i % cycles, 1
         # Same-site displacement: two armed specs on one site race for the
@@ -180,6 +194,18 @@ def build_schedule(
         (s, k, a) for s, k, a in schedule[cycles - 1]["mesh"]
         if s != "als.shard.gather"
     ] + [("als.shard.gather", "delay", 1)]
+    # The device-loss cycle's elastic drill must complete via remesh-resume:
+    # strip any OTHER raising als.shard.* draw from its mesh leg (the same
+    # reason kill/term cycles carry only the preemption — a second injected
+    # failure would mask the drill's verdict).
+    for c in range(cycles):
+        legs = schedule[c]["mesh"]
+        if any(s == "als.shard.collective" and k == "loss" for s, k, _ in legs):
+            schedule[c]["mesh"] = [
+                (s, k, a) for s, k, a in legs
+                if s == "als.shard.collective"
+                or not (s.startswith("als.shard.") and k in ("error", "ioerror", "oom", "loss"))
+            ]
     # A kill/term pipeline leg must not ALSO carry raising faults that could
     # fail the stage before the preemption fires.
     for c in range(cycles):
@@ -400,25 +426,39 @@ def _stream_in_process(ctx_factory, args, specs, cycle_seed: int) -> dict:
             "error": err, "faults": [f"{s}:{k}@{a}" for s, k, a in specs]}
 
 
-def _mesh_leg(specs) -> dict:
+def _mesh_leg(specs, ctx_factory=None) -> dict:
     """The boot leg: a mesh request that may exceed the visible devices (or
     lose half of them to a mesh.devices fault) must remesh down the ladder,
     never assert-crash. The leg then drives a tiny ROW-SHARDED fit on the
     booted mesh (``parallel.als.ShardedALSFit`` streamed), so the
     ``als.shard.gather``/``als.shard.stream`` chaos surface is exercised
     every cycle: an armed raising kind must surface as a failed fit (the
-    pipeline's fail-fast contract), never a hang or a wrong result."""
+    pipeline's fail-fast contract), never a hang or a wrong result.
+
+    A cycle arming ``als.shard.collective:loss`` is the DEVICE-LOSS cycle:
+    the fit runs through the elastic driver instead (the injected loss must
+    be survived via checkpoint -> remesh -> resume to parity, or fail
+    cleanly as ``MeshLost`` when no smaller rung exists), and the leg
+    additionally drives the degraded-serving drill — a retrieval bank
+    sealed at the full rung must promote onto the halved rung through the
+    real gates and answer with single-device parity."""
     import jax
 
     from albedo_tpu.parallel.mesh import make_mesh
 
+    elastic_cycle = any(
+        s == "als.shard.collective" and k == "loss" for s, k, _ in specs
+    )
     before = events.mesh_degraded.total()
     with _InProcessArm(specs) as armed:
         mesh = make_mesh(8)  # more than a 1-device CPU soak box has
-        shard_rec = _sharded_fit_drill(mesh, specs)
+        if elastic_cycle:
+            shard_rec = _elastic_fit_drill(mesh)
+        else:
+            shard_rec = _sharded_fit_drill(mesh, specs)
     n = int(np.prod(list(mesh.shape.values())))
     rc = 0 if (n >= 1 and shard_rec.pop("ok")) else 1
-    return {
+    out = {
         "job": "mesh_boot", "rc": rc,
         "devices": n, "visible": len(jax.devices()),
         "degraded": events.mesh_degraded.total() - before,
@@ -426,6 +466,12 @@ def _mesh_leg(specs) -> dict:
         "fired": armed.fired,
         "faults": [f"{s}:{k}@{a}" for s, k, a in specs],
     }
+    if elastic_cycle and ctx_factory is not None:
+        serving_rec = _degraded_serving_drill(ctx_factory())
+        if not serving_rec.pop("ok"):
+            out["rc"] = 1
+        out["degraded_serving"] = serving_rec
+    return out
 
 
 def _sharded_fit_drill(mesh, specs) -> dict:
@@ -465,6 +511,126 @@ def _sharded_fit_drill(mesh, specs) -> dict:
         "streamed_buckets": est.last_fit_report.get("streamed_buckets"),
         "unfired_faults": unfired,
     }
+
+
+def _elastic_fit_drill(mesh) -> dict:
+    """The device-loss cycle's fit drill: an armed ``als.shard.collective``
+    ``loss`` fires mid-sweep inside an elastic checkpointed fit. On a mesh
+    with a rung below, the driver must checkpoint, remesh down the ladder,
+    resume, and land factors matching a clean single-device fit at 1e-5 —
+    with the loss journaled and counted. On a 1-device mesh (a bare CPU
+    soak box) there is no rung left: the contract is a CLEAN ``MeshLost``
+    with journal status ``mesh_lost`` — never a hang, never a wrong
+    result."""
+    import json as _json
+    import tempfile
+
+    from albedo_tpu.datasets.synthetic import synthetic_stars
+    from albedo_tpu.models.als import ImplicitALS
+    from albedo_tpu.parallel.elastic import MeshLost, elastic_sharded_fit
+    from albedo_tpu.parallel.mesh import DATA_AXIS
+
+    matrix = synthetic_stars(n_users=48, n_items=32, mean_stars=5, seed=21)
+    kw = dict(rank=4, max_iter=2, batch_size=16, seed=0)
+    reference = ImplicitALS(**kw, chunked=False).fit(matrix)
+    est = ImplicitALS(**kw, mesh=mesh, sharded="streamed")
+    n_start = int(mesh.shape[DATA_AXIS])
+    losses_before = events.mesh_losses.total()
+    with tempfile.TemporaryDirectory() as d:
+        try:
+            model = elastic_sharded_fit(est, matrix, d, every=1)
+        except MeshLost:
+            journal = _json.loads((Path(d) / "journal.json").read_text())
+            ok = (
+                n_start == 1
+                and journal.get("status") == "mesh_lost"
+                and "cause" in journal
+                and events.mesh_losses.total() > losses_before
+            )
+            return {"ok": ok, "outcome": "mesh_lost", "n_shards": n_start,
+                    "journal_status": journal.get("status")}
+        except Exception as e:  # noqa: BLE001
+            return {"ok": False, "outcome": "failed", "error": repr(e)[-200:]}
+        journal = _json.loads((Path(d) / "journal.json").read_text())
+    me = est.last_fit_report.get("mesh_events", {})
+    delta = float(max(
+        np.abs(model.user_factors - reference.user_factors).max(),
+        np.abs(model.item_factors - reference.item_factors).max(),
+    ))
+    ok = (
+        me.get("losses", 0) >= 1
+        and me.get("resumes", 0) >= 1
+        and events.mesh_losses.total() > losses_before
+        and events.elastic_resumes.value(outcome="resumed") >= 1
+        and journal.get("status") == "complete"
+        and journal.get("mesh_events", {}).get("losses", 0) >= 1
+        and delta < 1e-5
+    )
+    return {
+        "ok": ok, "outcome": "resumed",
+        "losses": me.get("losses"), "resumes": me.get("resumes"),
+        "n_shards": f"{n_start} -> {me.get('n_shards')}",
+        "max_factor_delta": delta,
+        "journal_status": journal.get("status"),
+    }
+
+
+def _degraded_serving_drill(ctx) -> dict:
+    """Degraded-mesh serving acceptance: a retrieval bank built and SEALED
+    at the full rung (N item shards) promotes through the real BankStage
+    gates onto the halved rung — the mesh a device loss leaves serving —
+    and answers queries with single-device parity. A capacity refusal at
+    the smaller rung would stay a recorded non-quarantine rejection (the
+    reload convention); anything else is a violation."""
+    import jax
+
+    from albedo_tpu.parallel.mesh import make_mesh
+    from albedo_tpu.retrieval.bank import RetrievalBank
+    from albedo_tpu.retrieval.stage import BankStage
+
+    n = len(jax.devices())
+    if n <= 1:
+        # No smaller rung exists to promote onto: claiming "promoted" here
+        # would overstate chaos coverage — the elastic fit drill already
+        # validates the explicit 1-device (MeshLost) contract.
+        return {"ok": True, "outcome": "skipped (single device)"}
+
+    matrix = ctx.matrix()
+    model = ctx.als_model()
+
+    def mk_bank() -> RetrievalBank:
+        bank = RetrievalBank(max_batch=8)
+        bank.register_source(
+            "als", kind="user_rows", vectors=model.item_factors,
+            item_ids=np.asarray(matrix.item_ids),
+            user_vectors=model.user_factors,
+        )
+        return bank
+
+    full = make_mesh(n, data=1, item=n)
+    rung = make_mesh(max(1, n // 2), data=1, item=max(1, n // 2))
+    name = f"{ctx.tag}-elasticBank-drill.pkl"
+    try:
+        sealed = mk_bank().build(matrix=matrix, mesh=full)
+        sealed.save(name, lineage={"drill": "degraded-serving"})
+        stage = BankStage(mk_bank().build(matrix=matrix, mesh=full), matrix)
+        report = stage.reload(name, require_stamp=True, mesh=rung)
+        if report.get("outcome") != "promoted":
+            return {"ok": False, "outcome": report.get("outcome"),
+                    "gate": report.get("gate"), "why": report.get("why")}
+        # Parity: the promoted degraded-rung bank vs a single-device build.
+        q = np.arange(min(4, matrix.n_users), dtype=np.int64)
+        got = stage.bank.query(q, k=5, sources=("als",))["als"]
+        ref = mk_bank().build(matrix=matrix).query(q, k=5, sources=("als",))["als"]
+        delta = float(np.abs(got[0] - ref[0]).max()) if got[0].size else 0.0
+        ok = delta < 1e-5 and bool(np.array_equal(got[1], ref[1]))
+        return {
+            "ok": ok, "outcome": "promoted",
+            "built_at_shards": n, "promoted_on_shards": max(1, n // 2),
+            "max_score_delta": delta,
+        }
+    except Exception as e:  # noqa: BLE001
+        return {"ok": False, "outcome": "failed", "error": repr(e)[-200:]}
 
 
 def _serve_leg(ctx, specs) -> dict:
@@ -602,7 +768,7 @@ def run_soak(
     for c, plan in enumerate(schedule):
         cycle: dict = {"cycle": c + 1, "legs": []}
 
-        mesh_rec = _mesh_leg(plan["mesh"])
+        mesh_rec = _mesh_leg(plan["mesh"], ctx_factory=ctx_factory)
         cycle["legs"].append(mesh_rec)
         observe_in_process(mesh_rec, plan["mesh"])
         if mesh_rec["rc"] != 0:

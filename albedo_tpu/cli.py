@@ -90,6 +90,34 @@ def main(argv: list[str] | None = None) -> int:
         "0 = keep every step)",
     )
     parser.add_argument(
+        "--mesh-devices",
+        type=int,
+        default=0,
+        help="train ALS on a device mesh of N devices (0 = single device). "
+        "Fewer visible devices than requested remesh down the degraded "
+        "8 -> 4 -> 2 -> 1 ladder (parallel/mesh.py) — which is also how a "
+        "checkpointed sharded fit resumes on a smaller slice",
+    )
+    parser.add_argument(
+        "--sharded",
+        choices=("auto", "resident", "streamed"),
+        default="auto",
+        help="mesh-fit shard layout (--mesh-devices > 0): auto = the "
+        "capacity admission ladder picks, resident = row-sharded factor "
+        "tables with device-resident buckets, streamed = additionally "
+        "stream interaction buckets from the host per half-sweep. With "
+        "--checkpoint-every the fit runs the ELASTIC driver "
+        "(parallel/elastic.py): mesh-portable sweep-boundary checkpoints, "
+        "mid-fit device-loss detection, remesh-resume",
+    )
+    parser.add_argument(
+        "--shard-mode",
+        choices=("allgather", "ring"),
+        default="allgather",
+        help="sharded-fit source assembly: allgather (full table transient "
+        "per bucket) or ring (ppermute'd 1/n shards, cholesky only)",
+    )
+    parser.add_argument(
         "--no-compilation-cache",
         action="store_true",
         help="disable the persistent XLA executable cache (on by default; "

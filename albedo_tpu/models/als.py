@@ -255,7 +255,9 @@ class ImplicitALS:
     # buckets; False forces the replicated GSPMD path; "resident"/True force
     # row-sharded tables with resident buckets; "streamed" additionally
     # streams interaction buckets from the host per half-sweep (the star
-    # matrix is never device-resident whole).
+    # matrix is never device-resident whole). Checkpointed mesh fits run
+    # the ELASTIC driver (parallel/elastic.py): mesh-portable sweep-boundary
+    # checkpoints + mid-fit device-loss remesh-resume.
     sharded: Any | None = None
     # Source-factor assembly for the sharded path: "allgather" (full table
     # transient per bucket) or "ring" (ppermute'd 1/n shards, cholesky only).
@@ -890,5 +892,12 @@ class ImplicitALS:
             "capacity": None if admission is None else admission.to_dict(),
             "streamed_buckets": stats["streamed_buckets"],
             "sharded_shapes": stats["n_shapes"],
+            # Elasticity cost surface: a bare sharded fit observed no mesh
+            # events; the elastic driver (parallel/elastic.py) overwrites
+            # this with its loss/resume/checkpoint record.
+            "mesh_events": {
+                "losses": 0, "resumes": 0, "degradations": 0,
+                "checkpoint_s": 0.0, "n_shards": engine.n_shards,
+            },
         }
         return ALSModel(user_factors=user_f, item_factors=item_f, rank=self.rank)

@@ -8,6 +8,9 @@ Spark driver/executor runtime (SURVEY.md sections 2.5, 7).
 - ``topk`` — item-axis-sharded retrieval with k-per-device candidate merge.
 - ``lr`` — row-sharded feature batches for data-parallel LR training (psum
   gradient reductions = MLlib's treeAggregate).
+- ``elastic`` — the elastic loop around the sharded fit: mesh-portable
+  sweep-boundary checkpoints, mid-fit device-loss detection, remesh-resume
+  down the degraded ladder (ARCHITECTURE.md "Elastic operation").
 """
 
 from albedo_tpu.parallel.mesh import (  # noqa: F401
@@ -33,3 +36,8 @@ from albedo_tpu.parallel.topk import (  # noqa: F401
     sharded_topk_scores,
 )
 from albedo_tpu.parallel.lr import shard_feature_batch  # noqa: F401
+from albedo_tpu.parallel.elastic import (  # noqa: F401
+    CollectiveTimeout,
+    MeshLost,
+    elastic_sharded_fit,
+)

@@ -61,9 +61,16 @@ from albedo_tpu.utils import faults
 # half-sweep ahead of the source-shard assembly (the all-gather / ring pass),
 # `als.shard.stream` fires before every streamed bucket upload — so drills
 # can fail or kill a sharded fit mid-collective or mid-stream, exactly like
-# `als.chunked` does for the single-device degraded path.
+# `als.chunked` does for the single-device degraded path. `als.shard.
+# collective` is the ELASTIC surface: it fires at the head of every
+# half-sweep's collective phase (the psum Gramian + the per-bucket
+# all-gather/ring passes follow it), and its `loss` kind raises the
+# device-loss-shaped error a dead shard surfaces as — the elastic driver
+# (`parallel/elastic.py`) classifies it and runs the real checkpoint ->
+# remesh -> resume machinery instead of crashing the fit.
 SHARD_GATHER_FAULT = faults.site("als.shard.gather")
 SHARD_STREAM_FAULT = faults.site("als.shard.stream")
+SHARD_COLLECTIVE_FAULT = faults.site("als.shard.collective")
 
 
 def pad_bucket(b: Bucket, multiple: int) -> Bucket:
@@ -407,6 +414,7 @@ class ShardedALSFit:
         ``streamed`` (uploaded one at a time, ``als.shard.stream`` firing
         per upload) and device buckets otherwise."""
         SHARD_GATHER_FAULT.hit()
+        SHARD_COLLECTIVE_FAULT.hit()
         yty = self._gramian(source)
         for b in buckets:
             if streamed:

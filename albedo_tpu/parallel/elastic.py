@@ -1,0 +1,400 @@
+"""Elastic sharded ALS: mesh-portable checkpoints, mid-fit device-loss
+detection, and remesh-resume down the degraded ladder.
+
+PR 7 made device loss a handled event at mesh *creation* (the 8 -> 4 -> 2
+-> 1 boot ladder) and PR 8 made data bigger than one chip trainable — but
+the sharded fit itself stayed all-or-nothing: a shard dying mid-sweep
+killed the whole fit and every byte of progress, exactly the failure mode
+ALX-scale preemptible fleets (arXiv:2112.02194) and the parallel-ALS
+recovery literature (arXiv:1508.03110) treat as routine. This module is
+the missing elastic loop around ``ShardedALSFit``:
+
+1. **Sweep-boundary checkpoints** through
+   :class:`~albedo_tpu.utils.checkpoint.ShardedStepCheckpointer`:
+   row-sharded factor tables written as mesh-size-independent logical
+   tables (per-shard files + a sealed layout manifest), so a fit
+   checkpointed on 8 devices resumes bit-compatibly on 4, 2, or 1 — the
+   resuming engine re-shards the logical table onto ITS mesh.
+2. **Loss detection**: a collective watchdog deadline around every chunk's
+   dispatch (the all-gather/ring phases plus the fused health read that is
+   the completion barrier) classifies a HUNG shard, and
+   ``utils.retry.is_collective_lost`` classifies a DEAD one (jaxlib
+   ``DEADLINE_EXCEEDED``, distributed-runtime heartbeat failures, the
+   ``als.shard.collective`` fault site's ``loss`` kind).
+3. **Remesh-resume**: on a detected loss the driver checkpoints surviving
+   state where possible (the last sweep boundary's factors), steps one
+   rung down the ladder (:func:`~albedo_tpu.parallel.mesh.next_ladder_rung`),
+   re-prices the smaller rung through ``capacity.admit_ladder``
+   (:meth:`~albedo_tpu.models.als.ImplicitALS.admission_mesh`), re-shards,
+   and continues the sweep. ONE remediation attempt per loss budget; when
+   the budget is spent or no rung remains, the fit fails CLEANLY with
+   :class:`MeshLost` and a journaled cause (journal status ``mesh_lost``)
+   — never a hang, never silent data loss.
+
+Losses are counted in ``albedo_mesh_losses_total`` and resume outcomes in
+``albedo_elastic_resumes_total{outcome=}``; the fit report gains a
+``mesh_events`` record (losses, resumes, remesh trail, checkpoint overhead
+per sweep) so elasticity cost is visible in the bench trajectory.
+
+The driver always runs the ROW-SHARDED engine (``sharded="resident"`` or
+``"streamed"``, never the replicated GSPMD path): replicated tables cannot
+lose a shard without losing the whole model, so elasticity is only
+meaningful — and the `als.shard.collective` surface only exists — on the
+sharded layout. The admission ladder still re-prices every (re)mesh and
+still refuses when even streaming busts the budget.
+
+A note on hung (vs dead) shards: a chunk that exceeds the deadline is
+abandoned — its worker thread is left to finish (or wedge) in the
+background while the driver remeshes. On a real slice the wedged backend
+is unusable anyway and the remesh targets the surviving devices; on the
+CPU simulator an injected ``delay`` simply finishes harmlessly after the
+remesh has moved on.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+import time
+import threading
+from pathlib import Path
+
+import numpy as np
+
+from albedo_tpu.parallel.mesh import DATA_AXIS, make_mesh, next_ladder_rung
+from albedo_tpu.utils import capacity as capacity_mod
+from albedo_tpu.utils import events
+from albedo_tpu.utils.checkpoint import Preempted, ShardedStepCheckpointer
+from albedo_tpu.utils.retry import is_collective_lost
+
+log = logging.getLogger(__name__)
+
+_ENV_DEADLINE = "ALBEDO_COLLECTIVE_DEADLINE_S"
+_DEFAULT_DEADLINE_S = 300.0
+
+
+class CollectiveTimeout(RuntimeError):
+    """The collective watchdog's deadline tripped: a chunk's dispatch (the
+    all-gather/ring phases plus the fused health read that is its
+    completion barrier) did not finish in time — the signature of a hung
+    shard that will never answer. The message carries DEADLINE_EXCEEDED on
+    purpose: ``utils.retry.is_collective_lost`` classifies this exactly
+    like jaxlib's own collective timeout, so both land on the same elastic
+    path."""
+
+    def __init__(self, deadline_s: float, detail: str = ""):
+        super().__init__(
+            f"DEADLINE_EXCEEDED: sharded fit chunk exceeded the "
+            f"{deadline_s:g}s collective deadline"
+            + (f" ({detail})" if detail else "")
+        )
+        self.deadline_s = float(deadline_s)
+
+
+class MeshLost(RuntimeError):
+    """The elastic fit is out of options: a shard loss was detected and the
+    remediation budget is spent (or there is no smaller ladder rung). The
+    journal records status ``mesh_lost`` with the cause; the CLI surfaces
+    this as a plain failure (exit 1) — the surviving checkpoints remain,
+    so a rerun on healthy hardware resumes from the last boundary."""
+
+    def __init__(self, step: int, cause: BaseException, directory: Path | None = None):
+        super().__init__(
+            f"mesh lost at step {step}: {cause!r}"
+            + (f" (checkpoints in {directory})" if directory else "")
+        )
+        self.step = int(step)
+        self.cause = cause
+        self.directory = directory
+
+
+def collective_deadline_s() -> float:
+    """The collective watchdog deadline (seconds; <= 0 disables). Env
+    ``ALBEDO_COLLECTIVE_DEADLINE_S`` overrides the 300 s default — CPU
+    drills shrink it, giant real-slice sweeps may need to grow it."""
+    raw = os.environ.get(_ENV_DEADLINE)
+    if raw is None:
+        return _DEFAULT_DEADLINE_S
+    try:
+        return float(raw)
+    except ValueError:
+        return _DEFAULT_DEADLINE_S
+
+
+def _run_with_deadline(fn, deadline_s: float, detail: str = ""):
+    """Run ``fn`` under the collective deadline. A timeout abandons the
+    worker (see module docstring) and raises :class:`CollectiveTimeout`.
+
+    The worker is a DAEMON thread on purpose: concurrent.futures threads
+    are non-daemon and joined at interpreter exit, so an abandoned wedged
+    dispatch would turn the promised clean exit into a process that never
+    exits — the exact hang the deadline exists to prevent."""
+    if not deadline_s or deadline_s <= 0:
+        return fn()
+    result: list = []
+    error: list = []
+    done = threading.Event()
+
+    def worker():
+        try:
+            result.append(fn())
+        except BaseException as e:  # noqa: BLE001 — re-raised on the caller
+            error.append(e)
+        finally:
+            done.set()
+
+    t = threading.Thread(target=worker, name="albedo-elastic-chunk", daemon=True)
+    t.start()
+    if not done.wait(deadline_s):
+        raise CollectiveTimeout(deadline_s, detail)
+    if error:
+        raise error[0]
+    return result[0]
+
+
+_CHOSEN_TO_MODE = {
+    # The elastic driver never runs the replicated GSPMD rung (see module
+    # docstring): an ample budget keeps resident sharded tables.
+    "als_fit": "resident",
+    "als_fit_sharded": "resident",
+    "als_fit_sharded_streamed": "streamed",
+}
+
+
+def _resolve_mode(est, matrix, forced) -> tuple[str, dict | None]:
+    """One counted ``admit_ladder`` pricing per (re)mesh: the rung's
+    per-device shard sizes change with the device count, so every remesh
+    re-prices before any byte moves. ``forced`` pins the mode but the
+    re-pricing (and its refuse -> ``CapacityExceeded``) still runs."""
+    if not capacity_mod.enabled():
+        return (forced or "resident"), None
+    verdict = est.admission_mesh(matrix)  # raises CapacityExceeded on refuse
+    if forced:
+        return forced, verdict.to_dict()
+    return _CHOSEN_TO_MODE[verdict.chosen], verdict.to_dict()
+
+
+def elastic_sharded_fit(
+    est,
+    matrix,
+    directory: str | Path,
+    every: int = 5,
+    keep_last: int | None = None,
+    preemption=None,
+    watchdog=None,
+    max_losses: int = 1,
+    deadline_s: float | None = None,
+):
+    """Resumable, loss-tolerant sharded ALS training (see module doc).
+
+    ``est`` is an :class:`~albedo_tpu.models.als.ImplicitALS` with
+    ``est.mesh`` set; ``est.sharded`` of ``"resident"``/``"streamed"``/
+    ``True`` pins the shard mode, anything else lets the admission ladder
+    choose per mesh. Training runs in chunks of ``every`` sweeps; every
+    chunk boundary writes a mesh-portable sharded checkpoint, honors a
+    pending :class:`~albedo_tpu.utils.checkpoint.PreemptionHandler` stop
+    (journal ``preempted``, :class:`Preempted` -> CLI exit 75), and runs
+    the divergence ``watchdog`` (one damped re-run before
+    ``TrainingDiverged``) — the same contract as the single-device
+    ``checkpointed_als_fit``, extended with the loss state machine.
+
+    Returns the trained :class:`~albedo_tpu.models.als.ALSModel`;
+    ``est.last_fit_report`` carries the final chunk's report plus the
+    ``mesh_events`` record.
+    """
+    from albedo_tpu.models.als import ALSModel
+    from albedo_tpu.utils.watchdog import TrainingDiverged, damped
+
+    if est.mesh is None:
+        raise ValueError("elastic_sharded_fit needs an estimator with a mesh")
+    if every < 1:
+        raise ValueError(f"checkpoint interval must be >= 1, got {every}")
+    deadline = collective_deadline_s() if deadline_s is None else float(deadline_s)
+    forced = est.sharded if est.sharded in ("resident", "streamed") else (
+        "resident" if est.sharded is True else None
+    )
+    orig_est = est
+
+    ckpt = ShardedStepCheckpointer(directory, keep_last=keep_last)
+    degraded_before = events.mesh_degraded.total()
+    mesh_events: dict = {
+        "n_shards_start": int(est.mesh.shape[DATA_AXIS]),
+        "losses": 0,
+        "resumes": 0,
+        "remeshes": [],
+        "checkpoint_s": 0.0,
+    }
+
+    def _journal_extra(cause: str | None = None) -> dict:
+        extra: dict = {"mesh_events": dict(
+            mesh_events,
+            n_shards=int(est.mesh.shape[DATA_AXIS]),
+            degradations=int(events.mesh_degraded.total() - degraded_before),
+        )}
+        if cause is not None:
+            extra["cause"] = cause
+        if watchdog is not None and watchdog.trips:
+            extra["watchdog"] = watchdog.trips
+        return extra
+
+    latest = ckpt.restore_latest()  # sweeps stale shard tmps first
+    start, factors = 0, None
+    if latest is not None:
+        start, arrays = latest
+        if int(arrays["rank"]) != est.rank:
+            raise ValueError(
+                f"checkpoint rank {int(arrays['rank'])} != configured rank "
+                f"{est.rank}; refusing to resume into a wrong-rank model"
+            )
+        expect = ((matrix.n_users, est.rank), (matrix.n_items, est.rank))
+        got = (arrays["user_factors"].shape, arrays["item_factors"].shape)
+        if tuple(got[0]) != expect[0] or tuple(got[1]) != expect[1]:
+            raise ValueError(
+                f"checkpoint factor shapes {got} do not match the "
+                f"matrix/config {expect}"
+            )
+        factors = (arrays["user_factors"], arrays["item_factors"])
+        if start >= est.max_iter:
+            ckpt.write_journal("complete", start, est.max_iter, extra=_journal_extra())
+            return ALSModel.from_arrays(arrays)
+
+    # Admission prices THIS mesh's rung — including a resume landing on a
+    # smaller (degraded) mesh than the one that checkpointed.
+    mode, admission = _resolve_mode(est, matrix, forced)
+    ckpt.write_journal("running", start, est.max_iter, extra=_journal_extra())
+
+    report: dict = {}
+    model = None
+    resume_pending = False
+    while start < est.max_iter:
+        n = min(every, est.max_iter - start)
+        prev = factors
+        chunk_est = dataclasses.replace(
+            est, max_iter=n, init_factors=prev, sharded=mode
+        )
+        try:
+            model = _run_with_deadline(
+                lambda: chunk_est.fit(matrix), deadline,
+                detail=f"step {start}+{n} on {est.mesh.shape[DATA_AXIS]} shard(s)",
+            )
+        except Exception as e:  # noqa: BLE001 — classified below
+            if not is_collective_lost(e):
+                raise
+            # --- the loss state machine ----------------------------------
+            mesh_events["losses"] += 1
+            events.mesh_losses.inc()
+            n_now = int(est.mesh.shape[DATA_AXIS])
+            log.error(
+                "shard loss detected mid-fit at step %d on %d shard(s): %r",
+                start, n_now, e,
+            )
+            # Surviving state is already durable: every advance of `start`
+            # sealed a sweep-boundary checkpoint (and retention never
+            # prunes the newest step), so the loss costs at most the
+            # in-flight chunk — a loss before the first boundary has
+            # nothing to save and the resumed chunk re-seeds
+            # deterministically.
+            rung = next_ladder_rung(n_now)
+            if mesh_events["losses"] > max_losses or rung is None:
+                events.elastic_resumes.inc(outcome="failed")
+                ckpt.write_journal(
+                    "mesh_lost", start, est.max_iter,
+                    extra=_journal_extra(cause=repr(e)),
+                )
+                raise MeshLost(start, e, ckpt.directory) from e
+            new_mesh = make_mesh(rung)
+            mesh_events["remeshes"].append({
+                "step": int(start), "from_shards": n_now,
+                "to_shards": int(new_mesh.shape[DATA_AXIS]),
+                "cause": repr(e)[-200:],
+            })
+            est = dataclasses.replace(est, mesh=new_mesh)
+            # admit_ladder re-prices the smaller rung before the resume —
+            # per-device shard sizes double, so the chosen mode may change.
+            # A refuse is as terminal as running out of rungs: journal it
+            # (a journal stuck at "running" would read as a live fit) and
+            # fail as a clean MeshLost carrying the capacity refusal.
+            try:
+                mode, admission = _resolve_mode(est, matrix, forced)
+            except capacity_mod.CapacityExceeded as ce:
+                events.elastic_resumes.inc(outcome="failed")
+                ckpt.write_journal(
+                    "mesh_lost", start, est.max_iter,
+                    extra=_journal_extra(cause=f"{e!r}; resume refused: {ce}"),
+                )
+                raise MeshLost(start, ce, ckpt.directory) from ce
+            mesh_events["remeshes"][-1]["admission"] = admission
+            resume_pending = True
+            ckpt.write_journal(
+                "running", start, est.max_iter, extra=_journal_extra(cause=repr(e))
+            )
+            continue
+        report = chunk_est.last_fit_report
+        factors = (model.user_factors, model.item_factors)
+        if resume_pending:
+            resume_pending = False
+            mesh_events["resumes"] += 1
+            events.elastic_resumes.inc(outcome="resumed")
+        if watchdog is not None and watchdog.check(start + n, *factors):
+            # One damped re-run of the tripped chunk from the previous
+            # boundary (the single-device remediation contract). A device
+            # loss DURING this re-run is terminal but clean: remediating
+            # two distinct failure modes at once is not attempted — the
+            # loss is counted and journaled (never a journal stuck at
+            # "running") and the fit fails as MeshLost; the boundary
+            # checkpoints survive for a rerun on healthy hardware.
+            chunk_est = dataclasses.replace(
+                damped(est), max_iter=n, init_factors=prev, sharded=mode
+            )
+            try:
+                model = _run_with_deadline(
+                    lambda: chunk_est.fit(matrix), deadline, detail="damped re-run"
+                )
+            except Exception as e:  # noqa: BLE001 — classified below
+                if not is_collective_lost(e):
+                    raise
+                mesh_events["losses"] += 1
+                events.mesh_losses.inc()
+                events.elastic_resumes.inc(outcome="failed")
+                ckpt.write_journal(
+                    "mesh_lost", start, est.max_iter,
+                    extra=_journal_extra(
+                        cause=f"loss during damped remediation: {e!r}"
+                    ),
+                )
+                raise MeshLost(start, e, ckpt.directory) from e
+            factors = (model.user_factors, model.item_factors)
+            if watchdog.check(start + n, *factors):
+                ckpt.write_journal(
+                    "diverged", start, est.max_iter, extra=_journal_extra()
+                )
+                raise TrainingDiverged(start + n, watchdog.trips[-1]["kinds"])
+            watchdog.mark_remediated()
+        start += n
+        t0 = time.perf_counter()
+        ckpt.save(start, {
+            "user_factors": factors[0], "item_factors": factors[1],
+            "rank": np.int64(est.rank),
+        }, n_shards=int(est.mesh.shape[DATA_AXIS]))
+        mesh_events["checkpoint_s"] += time.perf_counter() - t0
+        if preemption is not None and preemption.should_stop() and start < est.max_iter:
+            ckpt.write_journal("preempted", start, est.max_iter, extra=_journal_extra())
+            raise Preempted(start, ckpt.directory)
+        ckpt.write_journal("running", start, est.max_iter, extra=_journal_extra())
+
+    mesh_events["checkpoint_s"] = round(mesh_events["checkpoint_s"], 4)
+    mesh_events["checkpoint_overhead_per_sweep_s"] = round(
+        mesh_events["checkpoint_s"] / max(1, start), 4
+    )
+    ckpt.write_journal("complete", start, est.max_iter, extra=_journal_extra())
+    final = dict(
+        mesh_events,
+        n_shards=int(est.mesh.shape[DATA_AXIS]),
+        degradations=int(events.mesh_degraded.total() - degraded_before),
+    )
+    orig_est.last_fit_report = dict(report, mesh_events=final, capacity=admission)
+    if model is None:  # pragma: no cover — start >= max_iter handled above
+        model = ALSModel(user_factors=factors[0], item_factors=factors[1],
+                         rank=est.rank)
+    return model

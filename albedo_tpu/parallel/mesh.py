@@ -134,6 +134,18 @@ def degraded_ladder(requested: int, available: int, item: int = 1) -> int:
     return max(1, n)
 
 
+def next_ladder_rung(n: int) -> int | None:
+    """The rung BELOW ``n`` on the degradation ladder (8 -> 4 -> 2 -> 1), or
+    ``None`` when there is nowhere left to go. The elastic remesh-resume
+    path (``parallel/elastic.py``) steps down one rung per detected shard
+    loss — halving matches :func:`degraded_ladder`'s boot-time semantics,
+    and a lost shard's row range is always covered by the surviving half
+    because factor tables re-shard from the mesh-portable checkpoint, not
+    from surviving device state."""
+    n = int(n)
+    return n // 2 if n > 1 else None
+
+
 def make_mesh(
     n_devices: int | None = None,
     data: int | None = None,

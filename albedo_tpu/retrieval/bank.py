@@ -262,7 +262,10 @@ class RetrievalBank:
     admission, device upload / mesh layout, calibration, version stamp) ->
     ``query()`` / ``query_similar()`` / ``publish_user_rows()``. ``save()``
     persists the build; ``RetrievalBank.load()`` restores it (un-built —
-    the loading process runs its own admission and upload).
+    the loading process runs its own admission and upload). ``reshard()``
+    re-lays a built bank onto a different mesh — the degraded-ladder rung a
+    device loss leaves serving — with version/calibration/overlay intact
+    (ARCHITECTURE.md "Elastic operation").
     """
 
     def __init__(self, item_block: int = 4096, max_batch: int = 64):
@@ -280,6 +283,8 @@ class RetrievalBank:
         self._uf: dict[str, object] = {}
         self._excl_map: dict[str, object] = {}
         self._rowmap: dict[str, dict[int, int]] = {}
+        self._excl_map_np: dict[str, np.ndarray] = {}
+        self._excl_np: np.ndarray | None = None
         self._excl_dev = None
         self._executables: dict[tuple, object] = {}
         self._exec_lock = threading.Lock()
@@ -326,35 +331,24 @@ class RetrievalBank:
         :class:`~albedo_tpu.utils.capacity.CapacityExceeded` (the refusal is
         recorded; the host fan-out keeps serving).
         """
-        import jax.numpy as jnp
-
         from albedo_tpu.utils import capacity
-        from albedo_tpu.utils.devcache import device_put_cached
 
         if not self.specs:
             raise ValueError("no sources registered")
         BUILD_FAULT.hit()
         t0 = time.perf_counter()
-        plan = capacity.plan_retrieval(
-            [
-                shape
-                for s in self.specs.values()
-                for shape in (
-                    [s.vectors.shape]
-                    + ([s.user_vectors.shape] if s.user_vectors is not None else [])
-                )
-            ],
-            excl_entries=int(exclude_table.size) if exclude_table is not None else 0,
-            generations=generations,
-            max_batch=self.max_batch,
-            item_block=self.item_block,
+        verdict = capacity.admit(
+            self._retrieval_plan(
+                mesh,
+                excl_entries=int(exclude_table.size) if exclude_table is not None else 0,
+                generations=generations,
+            ),
+            degradable=False, budget=budget,
         )
-        verdict = capacity.admit(plan, degradable=False, budget=budget)
         self.admission = verdict
         if verdict.verdict == "refuse":
             raise capacity.CapacityExceeded(verdict)
 
-        self.mesh = mesh
         matrix_item_ids = None if matrix is None else np.asarray(matrix.item_ids)
         for name in sorted(self.specs):
             spec = self.specs[name]
@@ -384,6 +378,64 @@ class RetrievalBank:
                         [self._rowmap[name].get(int(i), -1) for i in matrix_item_ids],
                         dtype=np.int32,
                     )
+            if excl_map is not None:
+                self._excl_map_np[name] = excl_map
+        if exclude_table is not None:
+            self._excl_np = np.asarray(exclude_table, dtype=np.int32)
+        self._upload(mesh)
+        self.version = self._content_hash()
+        self.built_at = time.time()
+        self._built = True
+        log.info(
+            "retrieval bank built: %d source(s), version %s, %.2fs%s",
+            len(self.specs), self.version, time.perf_counter() - t0,
+            f", mesh {dict(mesh.shape)}" if mesh is not None else "",
+        )
+        return self
+
+    def _retrieval_plan(self, mesh, excl_entries: int, generations: int):
+        """The bank's capacity plan for a given layout — PER DEVICE when a
+        mesh is given (tables row-shard over the item axis)."""
+        from albedo_tpu.parallel.mesh import ITEM_AXIS
+        from albedo_tpu.utils import capacity
+
+        return capacity.plan_retrieval(
+            [
+                shape
+                for s in self.specs.values()
+                for shape in (
+                    [s.vectors.shape]
+                    + ([s.user_vectors.shape] if s.user_vectors is not None else [])
+                )
+            ],
+            excl_entries=excl_entries,
+            generations=generations,
+            max_batch=self.max_batch,
+            item_block=self.item_block,
+            n_devices=1 if mesh is None else int(mesh.shape[ITEM_AXIS]),
+        )
+
+    def _upload(self, mesh) -> None:
+        """Device placement for the registered tables on ``mesh`` (or the
+        single default device when None) — the mesh-dependent tail of
+        ``build()``, shared with :meth:`reshard` so a built bank can re-lay
+        itself onto whatever mesh the degraded ladder gives. Clears any
+        previous layout's device state and shape-keyed executables (new
+        padded shapes = new programs); host-side products (row maps,
+        calibration, exclusion remaps) are layout-independent and kept."""
+        import jax.numpy as jnp
+
+        from albedo_tpu.utils.devcache import device_put_cached
+
+        self.mesh = mesh
+        self._vf.clear()
+        self._uf.clear()
+        self._excl_map.clear()
+        self._excl_dev = None
+        self._executables.clear()
+        for name in sorted(self.specs):
+            spec = self.specs[name]
+            excl_map = self._excl_map_np.get(name)
             owner = spec.owner if spec.owner is not None else spec
             if mesh is None:
                 self._vf[name] = device_put_cached(owner, spec.vectors)
@@ -405,16 +457,43 @@ class RetrievalBank:
                 )
                 if excl_map is not None:
                     self._excl_map[name] = excl_map  # host: remapped on host
-        if exclude_table is not None:
-            excl_np = np.asarray(exclude_table, dtype=np.int32)
-            self._excl_dev = excl_np if mesh is not None else jnp.asarray(excl_np)
-        self.version = self._content_hash()
-        self.built_at = time.time()
-        self._built = True
-        log.info(
-            "retrieval bank built: %d source(s), version %s, %.2fs%s",
-            len(self.specs), self.version, time.perf_counter() - t0,
-            f", mesh {dict(mesh.shape)}" if mesh is not None else "",
+        if self._excl_np is not None:
+            self._excl_dev = (
+                self._excl_np if mesh is not None else jnp.asarray(self._excl_np)
+            )
+
+    def reshard(self, mesh, budget: int | None = None,
+                generations: int = 1) -> "RetrievalBank":
+        """Re-lay a BUILT bank onto a different mesh — the degraded-mesh
+        serving move: after the ladder hands serving a smaller rung (or a
+        single device), the SAME bank re-prices and re-shards onto it with
+        its version, calibration, and overlay state intact. Admission runs
+        against the NEW layout's per-device price first (shards double when
+        the mesh halves); a refusal raises
+        :class:`~albedo_tpu.utils.capacity.CapacityExceeded` and leaves the
+        current layout serving — a recorded rejection, never a torn swap.
+        """
+        from albedo_tpu.utils import capacity
+
+        self._require_built()
+        verdict = capacity.admit(
+            self._retrieval_plan(
+                mesh,
+                excl_entries=0 if self._excl_np is None else int(self._excl_np.size),
+                generations=generations,
+            ),
+            degradable=False, budget=budget,
+        )
+        if verdict.verdict == "refuse":
+            raise capacity.CapacityExceeded(verdict)
+        self.admission = verdict
+        old = None if self.mesh is None else dict(self.mesh.shape)
+        self._upload(mesh)
+        log.warning(
+            "retrieval bank resharded: %s -> %s (version %s unchanged)",
+            old or "single-device",
+            dict(mesh.shape) if mesh is not None else "single-device",
+            self.version,
         )
         return self
 

@@ -140,12 +140,15 @@ class BankStage:
 
     # ----------------------------------------------------------- generations
 
+    _INCUMBENT_MESH = object()  # sentinel: "build on the incumbent's mesh"
+
     def reload(
         self,
         artifact_name: str,
         require_stamp: bool = False,
         probe_users: int = 4,
         probe_k: int = 10,
+        mesh=_INCUMBENT_MESH,
     ) -> dict:
         """Promote a bank artifact through the validation gates.
 
@@ -157,6 +160,14 @@ class BankStage:
         **capacity** (candidate priced ALONGSIDE the incumbent,
         ``generations=2``), **probe** (probe users answer with finite
         scores and in-range rows through the candidate's real query path).
+
+        ``mesh`` overrides the layout the candidate builds onto; the
+        default is the incumbent's own mesh. This is the degraded-serving
+        seam: the shard count is a per-process LAYOUT choice, not part of
+        the artifact — a bank saved by an 8-shard builder promotes onto
+        whatever rung the ladder gave THIS process (4, 2, 1, or a plain
+        single device), and a candidate too big for the smaller rung is a
+        recorded capacity rejection, never a quarantine.
         """
         from albedo_tpu.datasets import artifacts as store
         from albedo_tpu.utils.capacity import CapacityExceeded
@@ -214,7 +225,7 @@ class BankStage:
                     np.asarray(incumbent._excl_dev)
                     if incumbent._excl_dev is not None else None
                 ),
-                mesh=incumbent.mesh,
+                mesh=incumbent.mesh if mesh is self._INCUMBENT_MESH else mesh,
                 generations=2,  # incumbent + candidate resident through the swap
             )
         except CapacityExceeded as e:
@@ -254,3 +265,26 @@ class BankStage:
             "generation": self.generation,
             "version": candidate.version,
         }
+
+    def reshard(self, mesh) -> dict:
+        """Re-lay the LIVE bank onto a different mesh — the in-place
+        degraded-serving move after a device loss halves the serving slice
+        mid-flight (promotion-shaped swaps go through :meth:`reload`).
+        Re-admission runs first (per-device shards double when the mesh
+        halves); a refusal leaves the current layout serving and is a
+        recorded rejection, not a quarantine. Returns the stage snapshot.
+        """
+        from albedo_tpu.utils.capacity import CapacityExceeded
+
+        with self._swap_lock:
+            try:
+                self._bank.reshard(mesh)
+            except CapacityExceeded as e:
+                events.retrieval_promotions.inc(outcome="rejected")
+                log.warning("bank reshard refused: %s", e)
+                return {"outcome": "rejected", "gate": "capacity", "why": str(e)}
+        log.info(
+            "bank resharded onto %s",
+            dict(mesh.shape) if mesh is not None else "single-device",
+        )
+        return dict(self.snapshot(), outcome="resharded")

@@ -122,6 +122,7 @@ class HotSwapManager:
         error_rate_min_requests: int = 10,
         require_stamp: bool = False,
         canary_tolerance: float = 0.10,
+        mesh_devices: int = 1,
     ):
         self.service = service
         self.metrics = service.metrics
@@ -137,6 +138,18 @@ class HotSwapManager:
         # a PRESENT stamp that failed its canary or regressed past tolerance.
         self.require_stamp = bool(require_stamp)
         self.canary_tolerance = float(canary_tolerance)
+        # The serving layout's CURRENT device count — the capacity gate
+        # prices per device. Set this ONLY when generation state really is
+        # row-sharded over a mesh (the ROADMAP item-3 device-resident
+        # serving layout): today's default placement uploads WHOLE factor
+        # tables to one device, so anything but 1 there would under-admit
+        # by n and turn the gate's promise into a mid-swap OOM. A
+        # mesh-resident deployment passes the rung the degraded ladder
+        # actually gave it (and updates it after a mid-flight remesh via
+        # `set_mesh_devices`): a candidate judged affordable at 8 shards is
+        # re-judged honestly at 4 — the per-device share doubles each rung
+        # down.
+        self.mesh_devices = max(1, int(mesh_devices))
         self._promoted_canary_score: float | None = None
         # Effective stamp-gate baseline AFTER each promote, keyed by
         # generation number — rollback() restores the re-promoted
@@ -305,7 +318,7 @@ class HotSwapManager:
         plan = capacity.plan_serve(
             n_users=int(uf.shape[0]), n_items=int(vf.shape[0]),
             rank=int(model.rank), excl_entries=excl_entries,
-            generations=generations,
+            generations=generations, n_devices=self.mesh_devices,
         )
         verdict = capacity.admit(plan, degradable=False)
         if verdict.verdict != "fit":
@@ -319,7 +332,15 @@ class HotSwapManager:
             "required_bytes": verdict.required_bytes,
             "budget_bytes": verdict.budget_bytes,
             "generations_resident": generations,
+            "mesh_devices": self.mesh_devices,
         }
+
+    def set_mesh_devices(self, n: int) -> None:
+        """Record a serving-layout remesh (the degraded ladder moved): later
+        capacity gates price against the NEW rung. Serialized with reload
+        attempts so a gate mid-flight never sees a half-updated rung."""
+        with self._reload_lock:
+            self.mesh_devices = max(1, int(n))
 
     def _gate_probe(self, model: ALSModel, report: dict) -> tuple[np.ndarray, np.ndarray]:
         if not self._probe_dense.size:

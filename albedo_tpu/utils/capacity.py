@@ -496,21 +496,31 @@ def plan_serve(
     rank: int,
     excl_entries: int = 0,
     generations: int = 1,
+    n_devices: int = 1,
 ) -> CapacityPlan:
-    """Price ``generations`` device-resident serving generations.
+    """Price ``generations`` device-resident serving generations, PER
+    DEVICE.
 
     A generation pins both factor tables (``ALSModel.device_factors``) plus
     the -1-padded exclusion table (int32 per entry). During a hot swap TWO
     generations are resident — the incumbent never stops until the candidate
     passes its post-swap checks — which is exactly the pressure the reload
     capacity gate admits against.
+
+    ``n_devices > 1`` prices the mesh-resident serving layout (factor
+    tables and the exclusion table row-sharded over the mesh, the PR 8
+    layout): each device holds 1/n. This is what makes degraded-mesh
+    serving admission honest — after the ladder halves the mesh, the SAME
+    artifact's per-device price doubles, and the reload gate must re-judge
+    it against the smaller rung rather than the boot-time one.
     """
-    per_gen = (n_users + n_items) * rank * 4
+    n = max(1, int(n_devices))
+    per_gen = (_shard_pad(n_users, n) + _shard_pad(n_items, n)) * rank * 4 // n
     return CapacityPlan(
         workload="serve",
         items={
             "factor_tables": per_gen * max(1, generations),
-            "exclusion_table": int(excl_entries) * 4,
+            "exclusion_table": int(excl_entries) * 4 // n,
         },
     )
 
@@ -544,8 +554,10 @@ def plan_retrieval(
     max_batch: int = 64,
     item_block: int = 4096,
     k: int = 64,
+    n_devices: int = 1,
 ) -> CapacityPlan:
-    """Price ``generations`` resident retrieval-bank generations.
+    """Price ``generations`` resident retrieval-bank generations, PER
+    DEVICE.
 
     ``tables``: every table the bank pins — each source's (rows, dim)
     embedding table plus its user-row query table when it has one. During a
@@ -554,8 +566,16 @@ def plan_retrieval(
     admits against. Transient: one query batch's gathered rows + the
     blocked-MIPS working set (a (B, item_block) score block and the running
     (B, k) top-k) for the widest table.
+
+    ``n_devices > 1`` prices the mesh layout: source tables row-sharded
+    over the mesh (``parallel/topk.py`` serves per-shard top-k), so each
+    device holds 1/n of the resident tables while the per-batch transient
+    stays whole. A bank that fit at 8 shards can genuinely refuse at 4 —
+    the degraded-ladder rung doubles each device's share — and that
+    refusal stays a recorded non-quarantine rejection.
     """
-    resident = sum(int(n) * int(d) * 4 for n, d in tables)
+    n = max(1, int(n_devices))
+    resident = sum(_shard_pad(int(rows), n) * int(d) * 4 // n for rows, d in tables)
     max_dim = max((int(d) for _, d in tables), default=0)
     b = max(1, int(max_batch))
     transient = b * max_dim * 4 + b * (int(item_block) + int(k)) * 4

@@ -32,6 +32,7 @@ Fault tolerance (the ALX preemption-tolerance posture, arxiv 2112.02194):
 
 from __future__ import annotations
 
+import os
 import re
 import shutil
 import signal
@@ -255,6 +256,172 @@ class StepCheckpointer:
 
     def read_journal(self) -> dict | None:
         return read_json_or_none(self.journal_path())
+
+
+class ShardedStepCheckpointer(StepCheckpointer):
+    """Mesh-portable sweep-boundary checkpoints for the sharded ALS fit.
+
+    A sharded fit's factor tables live row-sharded across the mesh; on a
+    real multi-host slice no single host can materialize the whole table,
+    and the mesh that RESTORES may be smaller than the mesh that SAVED
+    (the degraded ladder after a device loss). So a step is written as a
+    **mesh-size-independent logical table**: one file per shard plus a
+    layout manifest that records how the shards reassemble::
+
+        step_00000002/
+          layout.json              # logical shapes, rank, n_shards,
+                                   # per-shard row ranges + sha256
+          user_000.npy ... user_NNN.npy   # row shards, zero-padded tail
+          item_000.npy ...
+        step_00000002.sha256       # step-level content manifest (dir hash)
+
+    ``restore`` concatenates the shards in row order and trims the zero
+    padding back to the logical row counts — the result is bit-identical
+    whatever shard count wrote it, so a fit checkpointed on 8 devices
+    resumes on 4, 2, or 1 (the resuming engine re-shards the logical table
+    onto ITS mesh). Every shard file is written tmp + ``os.replace`` and
+    ``layout.json`` lands LAST, so a kill mid-checkpoint leaves a step the
+    restore walk skips, never a half-written shard a manifest-less restore
+    would trust; stale tmp files are swept age-gated on resume
+    (:meth:`sweep_stale_tmps`, the jax-cache hardening pattern).
+
+    Everything else — ``steps()`` filtering, the backward restore walk,
+    ``keep_last`` retention, the journal — is inherited from
+    :class:`StepCheckpointer`.
+    """
+
+    LAYOUT_NAME = "layout.json"
+    _TMP_MARKER = ".albedo-tmp-"
+
+    @staticmethod
+    def _pad_split(table: np.ndarray, n_shards: int) -> list[np.ndarray]:
+        n = table.shape[0]
+        target = -(-n // n_shards) * n_shards
+        if target != n:
+            pad = np.zeros((target - n, *table.shape[1:]), dtype=table.dtype)
+            table = np.concatenate([table, pad], axis=0)
+        return np.split(table, n_shards, axis=0)
+
+    def _write_shard(self, step_dir: Path, name: str, shard: np.ndarray) -> dict:
+        from albedo_tpu.datasets.artifacts import file_sha256
+
+        path = step_dir / name
+        tmp = step_dir / f"{name}{self._TMP_MARKER}{os.getpid()}"
+        with open(tmp, "wb") as f:
+            np.save(f, np.ascontiguousarray(shard))
+        os.replace(tmp, path)  # a kill leaves tmp, never a torn shard
+        return {"file": name, "rows": int(shard.shape[0]),
+                "sha256": file_sha256(path)}
+
+    def save(self, step: int, tree: Any, n_shards: int = 1) -> Path:  # type: ignore[override]
+        """Write ``tree`` (``user_factors``/``item_factors``/``rank``) as
+        ``n_shards`` row shards per table. ``n_shards`` is a LAYOUT choice
+        (normally the saving mesh's shard count); restore is agnostic to it.
+        """
+        step_dir = self._step_dir(step)
+        step_dir.mkdir(parents=True, exist_ok=True)
+        n_shards = max(1, int(n_shards))
+        layout: dict = {"format": "sharded-factors-v1", "step": int(step),
+                        "n_shards": n_shards, "rank": int(tree["rank"]),
+                        "tables": {}}
+        for table in ("user_factors", "item_factors"):
+            arr = np.asarray(tree[table], dtype=np.float32)
+            shards = [
+                self._write_shard(step_dir, f"{table[:4]}_{i:03d}.npy", s)
+                for i, s in enumerate(self._pad_split(arr, n_shards))
+            ]
+            layout["tables"][table] = {
+                "logical_rows": int(arr.shape[0]),
+                "cols": int(arr.shape[1]),
+                "shards": shards,
+            }
+        # The layout seals the step: shards a kill orphaned before this
+        # write are invisible (restore only trusts what layout lists).
+        atomic_write_json(step_dir / self.LAYOUT_NAME, layout)
+        _SAVE_FAULT.hit(path=step_dir)
+        from albedo_tpu.datasets.artifacts import file_sha256
+
+        # The step manifest hashes ONLY layout.json: it already records
+        # every shard's sha256, so the layout digest covers the shard bytes
+        # transitively — re-hashing the full tables here would double the
+        # checkpoint I/O the elastic driver pays every `every` sweeps.
+        atomic_write_json(
+            self._manifest_path(step),
+            {"sha256": file_sha256(step_dir / self.LAYOUT_NAME), "step": step},
+        )
+        if self.keep_last is not None:
+            self.prune(self.keep_last)
+        return step_dir
+
+    def verify(self, step: int) -> bool:
+        """Manifest check against the layout digest (see ``save``); per-shard
+        content is verified at restore against the layout's recorded
+        sha256s. A missing manifest leaves the restore attempt to decide,
+        matching the parent's semantics."""
+        manifest = read_json_or_none(self._manifest_path(step))
+        if manifest is None:
+            return True
+        from albedo_tpu.datasets.artifacts import file_sha256
+
+        layout_path = self._step_dir(step) / self.LAYOUT_NAME
+        try:
+            return manifest.get("sha256") == file_sha256(layout_path)
+        except OSError:
+            return False
+
+    def restore(self, step: int) -> Any:
+        from albedo_tpu.datasets.artifacts import file_sha256
+
+        step_dir = self._step_dir(step)
+        _RESTORE_FAULT.hit(path=step_dir)
+        layout = read_json_or_none(step_dir / self.LAYOUT_NAME)
+        if not layout or layout.get("format") != "sharded-factors-v1":
+            raise ValueError(f"{step_dir.name}: no sealed shard layout")
+        out: dict[str, Any] = {"rank": np.int64(layout["rank"])}
+        for table, rec in layout["tables"].items():
+            parts = []
+            for shard in rec["shards"]:
+                p = step_dir / shard["file"]
+                if file_sha256(p) != shard["sha256"]:
+                    raise ValueError(
+                        f"{step_dir.name}/{shard['file']}: shard checksum "
+                        f"mismatch (half-written or corrupted)"
+                    )
+                parts.append(np.load(p, allow_pickle=False))
+            full = np.concatenate(parts, axis=0)[: rec["logical_rows"]]
+            if full.shape != (rec["logical_rows"], rec["cols"]):
+                raise ValueError(
+                    f"{step_dir.name}/{table}: reassembled shape "
+                    f"{full.shape} != logical {(rec['logical_rows'], rec['cols'])}"
+                )
+            out[table] = full
+        return out
+
+    def sweep_stale_tmps(self, max_age_s: float = 3600.0) -> int:
+        """Remove shard tmp files a killed writer left behind (best-effort,
+        age-gated like the jax-cache hardening: a young tmp may belong to a
+        LIVE concurrent writer whose ``os.replace`` must not be broken).
+        Called on resume; returns the number of files removed."""
+        removed = 0
+        now = time.time()
+        try:
+            for p in self.directory.rglob(f"*{self._TMP_MARKER}*"):
+                try:
+                    if now - p.stat().st_mtime >= max_age_s:
+                        p.unlink()
+                        removed += 1
+                except OSError:
+                    continue
+        except OSError:
+            pass
+        return removed
+
+    def restore_latest(self) -> tuple[int, Any] | None:
+        # Resume entry point: clear any stale half-written shard tmps FIRST
+        # so nothing in the directory predating this process can ever be
+        # mistaken for live checkpoint state.
+        self.sweep_stale_tmps()
+        return super().restore_latest()
 
 
 def checkpointed_als_fit(

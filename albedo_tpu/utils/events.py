@@ -217,6 +217,10 @@ STREAM_PUBLISHES_TOTAL = "albedo_stream_publishes_total"
 CAPACITY_VERDICTS_TOTAL = "albedo_capacity_verdicts_total"
 MESH_DEGRADED_TOTAL = "albedo_mesh_degraded_total"
 
+# Elastic sharded operation (PR 12).
+MESH_LOSSES_TOTAL = "albedo_mesh_losses_total"
+ELASTIC_RESUMES_TOTAL = "albedo_elastic_resumes_total"
+
 # Retrieval bank (ROADMAP item 5).
 RETRIEVAL_QUERIES_TOTAL = "albedo_retrieval_queries_total"
 RETRIEVAL_FALLBACKS_TOTAL = "albedo_retrieval_fallbacks_total"
@@ -351,6 +355,21 @@ mesh_degraded = global_counter(
     MESH_DEGRADED_TOTAL,
     "Mesh constructions that remeshed to fewer devices than requested "
     "(device loss or an injected mesh.devices fault).",
+)
+# The elastic sharded plane (PR 12): mid-fit shard losses detected by the
+# collective watchdog, and what the remesh-resume machinery did about them.
+mesh_losses = global_counter(
+    MESH_LOSSES_TOTAL,
+    "Mid-fit mesh shard losses detected by the collective watchdog "
+    "(DEADLINE_EXCEEDED / heartbeat failure / injected loss fault) during "
+    "a sharded fit.",
+)
+elastic_resumes = global_counter(
+    ELASTIC_RESUMES_TOTAL,
+    "Elastic remesh-resume attempts after a mid-fit shard loss, by outcome "
+    "(resumed = the fit continued on a smaller mesh rung; failed = no rung "
+    "left or the resumed chunk failed -> MeshLost).",
+    ("outcome",),
 )
 # The retrieval bank (ROADMAP item 5): fused candidate queries per source,
 # bank-failure fallbacks to the host fan-out, and bank generation swaps.

@@ -22,6 +22,13 @@ kind        effect at the Nth hit
             allocation throws (``utils.retry.is_resource_exhausted``
             classifies both as permanent; ``utils.capacity.admit`` converts
             one fired at ``capacity.admit`` into an over-budget verdict)
+``loss``    raise :class:`InjectedDeviceLoss` — a stand-in for the
+            ``DEADLINE_EXCEEDED`` / distributed-runtime heartbeat failure a
+            dead or hung mesh shard surfaces as mid-collective
+            (``utils.retry.is_collective_lost`` classifies both as
+            permanent; the elastic sharded fit (``parallel/elastic.py``)
+            catches one fired at ``als.shard.collective`` and runs the real
+            checkpoint -> remesh -> resume machinery)
 ==========  ================================================================
 
 Arming is programmatic (``faults.site("artifact.load").arm(kind="corrupt")``)
@@ -41,7 +48,7 @@ code by ``tests/test_fault_sites.py``): ``artifact.load``,
 ``crawler.transport``, ``pipeline.stage``, ``pipeline.stage.<name>``,
 ``serving.source.<name>``, ``serving.rank``, ``serving.breaker.<name>``,
 ``reload.load``, ``reload.validate``, ``capacity.admit``, ``mesh.devices``,
-``als.chunked``.
+``als.chunked``, ``als.shard.collective``.
 """
 
 from __future__ import annotations
@@ -56,7 +63,7 @@ from pathlib import Path
 from albedo_tpu.utils import events
 
 _ENV_VAR = "ALBEDO_FAULTS"
-KINDS = ("error", "ioerror", "corrupt", "delay", "kill", "term", "oom")
+KINDS = ("error", "ioerror", "corrupt", "delay", "kill", "term", "oom", "loss")
 
 
 class FaultInjected(RuntimeError):
@@ -67,6 +74,15 @@ class InjectedResourceExhausted(MemoryError):
     """The injected OOM (kind=oom): message and classification match what a
     real ``XlaRuntimeError: RESOURCE_EXHAUSTED`` looks like to the retry
     predicates, without this module importing jax."""
+
+
+class InjectedDeviceLoss(RuntimeError):
+    """The injected mid-collective device loss (kind=loss): message and
+    classification match what a dead/hung mesh shard surfaces as on a real
+    slice — jaxlib's ``DEADLINE_EXCEEDED`` collective timeout or a
+    distributed-runtime heartbeat failure — so
+    ``utils.retry.is_collective_lost`` treats both identically, without
+    this module importing jax."""
 
 
 @dataclasses.dataclass
@@ -232,6 +248,12 @@ class FaultRegistry:
             raise InjectedResourceExhausted(
                 f"RESOURCE_EXHAUSTED: injected out-of-memory at fault site "
                 f"{site!r} (simulated over-HBM allocation)"
+            )
+        if spec.kind == "loss":
+            raise InjectedDeviceLoss(
+                f"DEADLINE_EXCEEDED: injected device loss at fault site "
+                f"{site!r} (simulated collective timeout / heartbeat failure "
+                f"of a mesh shard)"
             )
         raise FaultInjected(f"injected fault at site {site!r}")
 

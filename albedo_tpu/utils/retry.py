@@ -59,12 +59,46 @@ def is_resource_exhausted(exc: BaseException) -> bool:
     return any(m in msg for m in _RESOURCE_EXHAUSTED_MARKERS[:2])
 
 
+# Substrings a dead/hung collective carries, across backends and jax
+# versions: jaxlib surfaces a shard that stopped answering as an
+# XlaRuntimeError DEADLINE_EXCEEDED from the stuck all-gather/psum, and the
+# distributed runtime (coordination service) reports the lost worker as a
+# missed-heartbeat failure. Classification is by name + message, like the
+# OOM predicate above — which also covers the fault harness's
+# InjectedDeviceLoss without importing jax here.
+_COLLECTIVE_LOST_MARKERS = (
+    "DEADLINE_EXCEEDED", "DEADLINE EXCEEDED", "heartbeat",
+    "coordination service", "task disconnected", "device lost",
+)
+
+
+def is_collective_lost(exc: BaseException) -> bool:
+    """True for device-loss-shaped collective failures: a jaxlib
+    ``XlaRuntimeError`` whose message says DEADLINE_EXCEEDED (the stuck
+    collective's timeout), a distributed-runtime heartbeat/coordination
+    failure, the elastic watchdog's own :class:`CollectiveTimeout`-shaped
+    deadline trip, or the fault harness's injected ``loss`` kind. These are
+    PERMANENT for retry purposes: the shard is dead or wedged, so every
+    retry re-hangs the same collective until the backoff budget burns —
+    the caller must fail FAST to the elastic remesh-resume path
+    (``parallel/elastic.py``) instead."""
+    msg = str(exc)
+    name = type(exc).__name__
+    if name in ("InjectedDeviceLoss", "CollectiveTimeout"):
+        return True
+    if "XlaRuntimeError" in name or "RuntimeError" in name:
+        return any(m.lower() in msg.lower() for m in _COLLECTIVE_LOST_MARKERS)
+    return any(m.lower() in msg.lower() for m in _COLLECTIVE_LOST_MARKERS[:2])
+
+
 def default_retry_predicate(exc: BaseException) -> bool:
     """The shared baseline predicate: any Exception retries EXCEPT
-    resource exhaustion (see :func:`is_resource_exhausted`). Callers with
-    their own predicate should compose it:
+    resource exhaustion (see :func:`is_resource_exhausted`) and collective
+    device loss (see :func:`is_collective_lost`) — both re-fail identically
+    on retry and must fail fast to their degrade/elastic paths. Callers
+    with their own predicate should compose it:
     ``lambda e: my_check(e) and default_retry_predicate(e)``."""
-    return not is_resource_exhausted(exc)
+    return not (is_resource_exhausted(exc) or is_collective_lost(exc))
 
 
 class RetryAfter(Exception):

@@ -1812,7 +1812,8 @@ def scale_bench() -> dict:
     from albedo_tpu.datasets.synthetic import generate_scale_dataset
     from albedo_tpu.parallel import make_mesh
     from albedo_tpu.parallel.als import ShardedALSFit
-    from albedo_tpu.utils import capacity
+    from albedo_tpu.utils import capacity, events
+    from albedo_tpu.utils.checkpoint import ShardedStepCheckpointer
     from albedo_tpu.utils.watchdog import factor_health, health_dict
 
     users_per_chip = int(os.environ.get("ALBEDO_SCALE_USERS_PER_CHIP", "3000"))
@@ -1834,6 +1835,9 @@ def scale_bench() -> dict:
     curve = []
     for n in counts:
         n_users = users_per_chip * n
+        deg_before = events.mesh_degraded.total()
+        loss_before = events.mesh_losses.total()
+        resume_before = events.elastic_resumes.total()
         with tempfile.TemporaryDirectory() as d:
             ds = generate_scale_dataset(
                 d, n_users=n_users, n_items=n_items, mean_stars=mean_stars,
@@ -1864,6 +1868,21 @@ def scale_bench() -> dict:
                 fail("scale", f"non-finite factors at {n} devices")
             sweep_s = statistics.median(per_sweep)
 
+            # Elasticity cost: what ONE mesh-portable sweep-boundary
+            # checkpoint of this rung's factor tables costs (the elastic
+            # driver pays this every --checkpoint-every sweeps), plus any
+            # degradations/losses/resumes the rung's fits observed — so
+            # the bench trajectory shows what elastic operation costs
+            # instead of it being silent.
+            t0 = time.perf_counter()
+            ShardedStepCheckpointer(os.path.join(d, "ckpt")).save(
+                1, {"user_factors": np.asarray(u_out),
+                    "item_factors": np.asarray(i_out),
+                    "rank": np.int64(rank)},
+                n_shards=n,
+            )
+            ckpt_s = time.perf_counter() - t0
+
             # Explicit per-chip bytes model for one full sweep (both halves):
             # streamed slab upload + the local gathered block traffic + the
             # assembled source tables + the solved-row all-gathers.
@@ -1889,6 +1908,15 @@ def scale_bench() -> dict:
                 "per_sweep_trials": [round(t, 4) for t in per_sweep],
                 "achieved_gbps_per_chip": round(bytes_chip / max(sweep_s, 1e-9) / 1e9, 3),
                 "streamed_buckets_per_sweep": stats["streamed_buckets"],
+                "mesh_events": {
+                    "degradations": int(events.mesh_degraded.total() - deg_before),
+                    "losses": int(events.mesh_losses.total() - loss_before),
+                    "resumes": int(events.elastic_resumes.total() - resume_before),
+                    "checkpoint_s": round(ckpt_s, 4),
+                    "checkpoint_overhead_frac_per_sweep": round(
+                        ckpt_s / max(sweep_s, 1e-9), 4
+                    ),
+                },
             })
 
     base_s = curve[0]["per_sweep_s"]

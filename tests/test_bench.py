@@ -158,6 +158,12 @@ def test_scale_scenario_record_shape(monkeypatch, tmp_path):
         assert row["achieved_gbps_per_chip"] > 0
         assert row["streamed_buckets_per_sweep"] > 0
         assert row["n_users"] == 200 * row["n_devices"]  # fixed work per chip
+        # Elasticity cost is visible, not silent: per-rung mesh events +
+        # the measured sweep-boundary checkpoint overhead.
+        me = row["mesh_events"]
+        assert me["losses"] == 0 and me["resumes"] == 0
+        assert me["checkpoint_s"] > 0
+        assert me["checkpoint_overhead_frac_per_sweep"] >= 0
     assert rec["weak_scaling"][0]["efficiency_vs_1chip"] == 1.0
     for mode in ("allgather", "ring"):
         assert rec["largest_fittable"][mode]["max_users"] > 0

@@ -1,0 +1,532 @@
+"""Elastic sharded operation (ARCHITECTURE.md "Elastic operation"):
+mesh-portable sharded checkpoints (a step written at 8 shards restores
+bit-identically at any shard count), the collective-loss retry
+classification, the elastic fit's loss -> checkpoint -> remesh -> resume
+state machine (with the clean ``MeshLost`` terminal), degraded-mesh
+serving (bank reshard / promote-onto-a-smaller-rung), and the
+acceptance-grade cross-mesh kill-resume drill through the real CLI
+(chaos+slow; the in-process flavors here are the tier-1 coverage)."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from albedo_tpu.datasets.synthetic import synthetic_stars  # noqa: E402
+from albedo_tpu.models.als import ImplicitALS  # noqa: E402
+from albedo_tpu.parallel.elastic import (  # noqa: E402
+    CollectiveTimeout,
+    MeshLost,
+    elastic_sharded_fit,
+)
+from albedo_tpu.parallel.mesh import make_mesh, next_ladder_rung  # noqa: E402
+from albedo_tpu.utils import events, faults  # noqa: E402
+from albedo_tpu.utils.checkpoint import (  # noqa: E402
+    Preempted,
+    PreemptionHandler,
+    ShardedStepCheckpointer,
+)
+from albedo_tpu.utils.retry import (  # noqa: E402
+    RetriesExhausted,
+    default_retry_predicate,
+    is_collective_lost,
+    retry_call,
+)
+
+KW = dict(rank=8, max_iter=4, batch_size=32, seed=1)
+ATOL = 1e-5
+
+
+@pytest.fixture(scope="module")
+def matrix():
+    return synthetic_stars(n_users=64, n_items=48, mean_stars=6, seed=3)
+
+
+@pytest.fixture(scope="module")
+def reference(matrix):
+    """Uninterrupted single-device resident fit — the parity anchor."""
+    return ImplicitALS(**KW, chunked=False).fit(matrix)
+
+
+def _parity(model, reference, atol=ATOL):
+    np.testing.assert_allclose(model.user_factors, reference.user_factors, atol=atol)
+    np.testing.assert_allclose(model.item_factors, reference.item_factors, atol=atol)
+
+
+def _tree(rng_seed=0, rows=(13, 10), rank=4):
+    rng = np.random.default_rng(rng_seed)
+    return {
+        "user_factors": rng.normal(size=(rows[0], rank)).astype(np.float32),
+        "item_factors": rng.normal(size=(rows[1], rank)).astype(np.float32),
+        "rank": np.int64(rank),
+    }
+
+
+class TestShardedCheckpointer:
+    def test_per_shard_layout_and_roundtrip(self, tmp_path):
+        ck = ShardedStepCheckpointer(tmp_path)
+        tree = _tree()
+        ck.save(2, tree, n_shards=8)
+        step_dir = tmp_path / "step_00000002"
+        layout = json.loads((step_dir / "layout.json").read_text())
+        assert layout["format"] == "sharded-factors-v1"
+        assert layout["n_shards"] == 8
+        # 13 rows pad to 16 -> 8 shard files of 2 rows each.
+        assert len(layout["tables"]["user_factors"]["shards"]) == 8
+        assert len(list(step_dir.glob("user_*.npy"))) == 8
+        assert (tmp_path / "step_00000002.sha256").exists()
+        step, arrays = ck.restore_latest()
+        assert step == 2
+        np.testing.assert_array_equal(arrays["user_factors"], tree["user_factors"])
+        np.testing.assert_array_equal(arrays["item_factors"], tree["item_factors"])
+
+    @pytest.mark.parametrize("save_shards,restore_ok", [(8, True), (1, True), (3, True)])
+    def test_mesh_size_independent(self, tmp_path, save_shards, restore_ok):
+        """The logical table is bit-identical whatever shard count wrote
+        it — the mesh-portability contract."""
+        tree = _tree(rng_seed=save_shards)
+        ShardedStepCheckpointer(tmp_path).save(1, tree, n_shards=save_shards)
+        _, arrays = ShardedStepCheckpointer(tmp_path).restore_latest()
+        np.testing.assert_array_equal(arrays["user_factors"], tree["user_factors"])
+        np.testing.assert_array_equal(arrays["item_factors"], tree["item_factors"])
+
+    def test_unsealed_step_skipped_by_backward_walk(self, tmp_path):
+        """A kill before layout.json seals the step: the restore walk must
+        fall back to the previous sealed step, counted."""
+        ck = ShardedStepCheckpointer(tmp_path)
+        good = _tree(rng_seed=1)
+        ck.save(2, good, n_shards=4)
+        # Simulate the torn step 4: shard files present, NO layout.json.
+        torn = tmp_path / "step_00000004"
+        torn.mkdir()
+        (torn / "user_000.npy").write_bytes(b"\x93NUMPY garbage")
+        before = events.checkpoint_fallbacks.total()
+        step, arrays = ck.restore_latest()
+        assert step == 2
+        np.testing.assert_array_equal(arrays["user_factors"], good["user_factors"])
+        assert events.checkpoint_fallbacks.total() > before
+
+    def test_corrupt_shard_detected(self, tmp_path):
+        ck = ShardedStepCheckpointer(tmp_path)
+        ck.save(1, _tree(rng_seed=2), n_shards=4)
+        good = _tree(rng_seed=3)
+        ck.save(2, good, n_shards=4)
+        # Flip a byte of one of step 2's shard files, and refresh the
+        # step-level manifest so only the per-shard sha256 can catch it
+        # (the manifest-less-restore-must-not-trust-it contract).
+        shard = sorted((tmp_path / "step_00000002").glob("item_*.npy"))[1]
+        data = bytearray(shard.read_bytes())
+        data[len(data) // 2] ^= 0xFF
+        shard.write_bytes(bytes(data))
+        (tmp_path / "step_00000002.sha256").unlink()
+        step, arrays = ck.restore_latest()
+        assert step == 1  # fell back past the corrupted step
+        np.testing.assert_array_equal(
+            arrays["user_factors"], _tree(rng_seed=2)["user_factors"]
+        )
+
+    def test_stale_tmp_sweep_age_gated(self, tmp_path):
+        ck = ShardedStepCheckpointer(tmp_path)
+        ck.save(1, _tree(), n_shards=2)
+        stale = tmp_path / "step_00000001" / "user_000.npy.albedo-tmp-999"
+        stale.write_bytes(b"half-written shard")
+        fresh = tmp_path / "step_00000001" / "item_000.npy.albedo-tmp-998"
+        fresh.write_bytes(b"live writer")
+        old = time.time() - 7200
+        os.utime(stale, (old, old))
+        ck.restore_latest()  # resume sweeps stale tmps first
+        assert not stale.exists(), "aged-out tmp must be swept on resume"
+        assert fresh.exists(), "a young tmp may belong to a live writer"
+
+    def test_keep_last_prunes_shard_steps(self, tmp_path):
+        ck = ShardedStepCheckpointer(tmp_path, keep_last=2)
+        for step in (1, 2, 3):
+            ck.save(step, _tree(rng_seed=step), n_shards=2)
+        assert ck.steps() == [2, 3]
+        assert not (tmp_path / "step_00000001").exists()
+
+
+class TestLossClassification:
+    def test_injected_loss_and_timeout_are_lost(self):
+        assert is_collective_lost(faults.InjectedDeviceLoss("DEADLINE_EXCEEDED: x"))
+        assert is_collective_lost(CollectiveTimeout(1.5))
+
+    def test_jaxlib_shaped_messages_are_lost(self):
+        class XlaRuntimeError(RuntimeError):
+            pass
+
+        assert is_collective_lost(
+            XlaRuntimeError("DEADLINE_EXCEEDED: all-gather timed out")
+        )
+        assert is_collective_lost(
+            RuntimeError("coordination service heartbeat failure: task 3")
+        )
+
+    def test_ordinary_errors_still_retry(self):
+        assert not is_collective_lost(ValueError("shapes do not match"))
+        assert default_retry_predicate(ValueError("transient"))
+        assert not default_retry_predicate(
+            faults.InjectedDeviceLoss("DEADLINE_EXCEEDED")
+        )
+
+    def test_retry_fails_fast_on_loss(self):
+        """A dead collective must not burn the backoff budget re-hanging:
+        the shared predicate propagates it on the FIRST attempt."""
+        calls = []
+
+        def attempt():
+            calls.append(1)
+            raise faults.InjectedDeviceLoss("DEADLINE_EXCEEDED: heartbeat")
+
+        with pytest.raises(faults.InjectedDeviceLoss):
+            retry_call(attempt, site="test", sleeper=lambda s: None)
+        assert len(calls) == 1
+
+    def test_transients_still_retry_through(self):
+        calls = []
+
+        def attempt():
+            calls.append(1)
+            if len(calls) < 3:
+                raise OSError("flaky disk")
+            return "ok"
+
+        assert retry_call(attempt, site="test", sleeper=lambda s: None) == "ok"
+        assert len(calls) == 3
+
+
+class TestNextLadderRung:
+    @pytest.mark.parametrize("n,expect", [(8, 4), (4, 2), (2, 1), (1, None), (3, 1)])
+    def test_rungs(self, n, expect):
+        assert next_ladder_rung(n) == expect
+
+
+class TestElasticFit:
+    def test_clean_fit_parity_and_report(self, matrix, reference, tmp_path):
+        est = ImplicitALS(**KW, mesh=make_mesh(8), sharded="streamed")
+        model = elastic_sharded_fit(est, matrix, tmp_path, every=2)
+        _parity(model, reference)
+        me = est.last_fit_report["mesh_events"]
+        assert me["losses"] == 0 and me["resumes"] == 0
+        assert me["checkpoint_s"] > 0
+        journal = json.loads((tmp_path / "journal.json").read_text())
+        assert journal["status"] == "complete"
+        assert journal["mesh_events"]["n_shards"] == 8
+
+    def test_cross_mesh_resume_parity(self, matrix, reference, tmp_path):
+        """Checkpointed on 8 shards, resumed on a 2-device mesh (and the
+        8-shard step restores on it bit-compatibly) — the in-process flavor
+        of the CLI acceptance drill."""
+        est8 = ImplicitALS(**KW, mesh=make_mesh(8), sharded="streamed")
+        with pytest.raises(Preempted):
+            preemption = PreemptionHandler()
+            preemption.request_stop()  # stop at the FIRST chunk boundary
+            elastic_sharded_fit(
+                est8, matrix, tmp_path, every=2, preemption=preemption
+            )
+        layout = json.loads(
+            next(p for p in tmp_path.glob("step_*") if p.is_dir())
+            .joinpath("layout.json").read_text()
+        )
+        assert layout["n_shards"] == 8
+        est2 = ImplicitALS(**KW, mesh=make_mesh(2), sharded="streamed")
+        model = elastic_sharded_fit(est2, matrix, tmp_path, every=2)
+        _parity(model, reference)
+        journal = json.loads((tmp_path / "journal.json").read_text())
+        assert journal["status"] == "complete"
+
+    def test_resume_on_single_device_rung(self, matrix, reference, tmp_path):
+        """All the way down the ladder: an 8-shard checkpoint resumes on a
+        1-device mesh."""
+        est8 = ImplicitALS(**KW, mesh=make_mesh(8), sharded="streamed")
+        preemption = PreemptionHandler()
+        preemption.request_stop()
+        with pytest.raises(Preempted):
+            elastic_sharded_fit(
+                est8, matrix, tmp_path, every=2, preemption=preemption
+            )
+        est1 = ImplicitALS(**KW, mesh=make_mesh(1), sharded="resident")
+        model = elastic_sharded_fit(est1, matrix, tmp_path, every=2)
+        _parity(model, reference)
+
+    def test_injected_loss_remeshes_and_resumes(self, matrix, reference, tmp_path):
+        """The tentpole drill: a shard dies mid-sweep (kind=loss at the
+        collective), the fit checkpoints survivors, remeshes 8 -> 4,
+        re-prices, resumes, and still lands the reference factors — with
+        the loss journaled and counted."""
+        faults.arm("als.shard.collective", kind="loss", at=3)
+        before_losses = events.mesh_losses.total()
+        est = ImplicitALS(**KW, mesh=make_mesh(8), sharded="streamed")
+        model = elastic_sharded_fit(est, matrix, tmp_path, every=2)
+        _parity(model, reference)
+        me = est.last_fit_report["mesh_events"]
+        assert me["losses"] == 1 and me["resumes"] == 1
+        assert me["remeshes"][0]["from_shards"] == 8
+        assert me["remeshes"][0]["to_shards"] == 4
+        assert me["remeshes"][0]["admission"] is not None
+        assert events.mesh_losses.total() == before_losses + 1
+        assert events.elastic_resumes.value(outcome="resumed") == 1
+        journal = json.loads((tmp_path / "journal.json").read_text())
+        assert journal["status"] == "complete"
+        assert journal["mesh_events"]["losses"] == 1
+
+    def test_hung_collective_trips_the_deadline(self, matrix, reference, tmp_path):
+        """A HUNG (not dead) shard: an injected delay overruns the
+        collective deadline, classifies as lost, and the fit remeshes and
+        completes — the watchdog path, not the exception path. Both rungs'
+        executables are warmed first so the deadline measures the hang,
+        not cold XLA compiles (the production default is 300 s for exactly
+        that reason)."""
+        for n in (4, 2):
+            ImplicitALS(**KW, mesh=make_mesh(n), sharded="resident").fit(matrix)
+        faults.arm("als.shard.collective", kind="delay", at=1, param=5.0)
+        est = ImplicitALS(**KW, mesh=make_mesh(4), sharded="resident")
+        model = elastic_sharded_fit(
+            est, matrix, tmp_path, every=2, deadline_s=1.5
+        )
+        _parity(model, reference)
+        me = est.last_fit_report["mesh_events"]
+        assert me["losses"] == 1 and me["resumes"] == 1
+        assert "DEADLINE_EXCEEDED" in me["remeshes"][0]["cause"]
+
+    def test_exhausted_budget_is_clean_mesh_lost(self, matrix, tmp_path):
+        """Loss budget spent (or no rung left): a clean MeshLost with the
+        cause journaled — never a hang, never a silent wrong result."""
+        faults.arm("als.shard.collective", kind="loss", at=1, times=0)
+        est = ImplicitALS(**KW, mesh=make_mesh(2), sharded="resident")
+        with pytest.raises(MeshLost):
+            elastic_sharded_fit(est, matrix, tmp_path, every=2, max_losses=1)
+        journal = json.loads((tmp_path / "journal.json").read_text())
+        assert journal["status"] == "mesh_lost"
+        assert "DEADLINE_EXCEEDED" in journal["cause"]
+        assert events.elastic_resumes.value(outcome="failed") == 1
+
+    def test_resume_refused_by_capacity_is_journaled_mesh_lost(
+        self, matrix, tmp_path, monkeypatch
+    ):
+        """The smaller rung re-prices BIGGER per device; when even the
+        streamed plan busts the budget there, the refused resume must be
+        journaled (not left at status `running`) and fail as MeshLost."""
+        from albedo_tpu.utils import capacity
+
+        est = ImplicitALS(**KW, mesh=make_mesh(8), sharded="streamed")
+        shapes_u, shapes_i = est._plan_shapes(matrix)
+        args = (shapes_u, shapes_i, matrix.n_users, matrix.n_items, est.rank)
+        s8 = capacity.plan_fit_sharded(*args, 8, streamed=True).required_bytes
+        s4 = capacity.plan_fit_sharded(*args, 4, streamed=True).required_bytes
+        assert s4 > s8  # per-device share grows as the rung shrinks
+        monkeypatch.setenv("ALBEDO_MEM_HEADROOM", "1.0")
+        monkeypatch.setenv("ALBEDO_DEVICE_MEM_BYTES", str(s8))
+        faults.arm("als.shard.collective", kind="loss", at=1)
+        with pytest.raises(MeshLost):
+            elastic_sharded_fit(est, matrix, tmp_path, every=2)
+        journal = json.loads((tmp_path / "journal.json").read_text())
+        assert journal["status"] == "mesh_lost"
+        assert "resume refused" in journal["cause"]
+        assert events.elastic_resumes.value(outcome="failed") == 1
+
+    def test_loss_during_damped_remediation_is_journaled_terminal(
+        self, matrix, tmp_path
+    ):
+        """A shard loss DURING the divergence watchdog's damped re-run is
+        terminal but clean: counted, journal status `mesh_lost` (never left
+        at `running`), MeshLost raised — two distinct failure modes are not
+        remediated at once."""
+        from albedo_tpu.utils.watchdog import DivergenceWatchdog
+
+        # The watchdog fault scribbles NaN into the FIRST boundary check
+        # (-> damped re-run); chunk 1 (2 sweeps) hits the collective site 4
+        # times, so at=5 fires inside the damped re-run itself.
+        faults.arm("train.watchdog", kind="error", at=1)
+        faults.arm("als.shard.collective", kind="loss", at=5)
+        est = ImplicitALS(**KW, mesh=make_mesh(4), sharded="streamed")
+        with pytest.raises(MeshLost):
+            elastic_sharded_fit(
+                est, matrix, tmp_path, every=2, watchdog=DivergenceWatchdog()
+            )
+        journal = json.loads((tmp_path / "journal.json").read_text())
+        assert journal["status"] == "mesh_lost"
+        assert "damped remediation" in journal["cause"]
+        assert events.mesh_losses.total() == 1
+        assert events.elastic_resumes.value(outcome="failed") == 1
+
+    def test_non_loss_errors_propagate_unremediated(self, matrix, tmp_path):
+        """An ordinary injected error on the shard surface is NOT a device
+        loss: the elastic driver must not eat it with a remesh."""
+        faults.arm("als.shard.gather", kind="error", at=1)
+        est = ImplicitALS(**KW, mesh=make_mesh(4), sharded="resident")
+        with pytest.raises(faults.FaultInjected):
+            elastic_sharded_fit(est, matrix, tmp_path, every=2)
+        assert events.mesh_losses.total() == 0
+
+
+class TestDegradedServing:
+    def _bank(self, rank=8):
+        from albedo_tpu.retrieval.bank import RetrievalBank
+
+        rng = np.random.default_rng(7)
+        bank = RetrievalBank(max_batch=8)
+        bank.register_source(
+            "als", kind="user_rows",
+            vectors=rng.normal(size=(40, rank)).astype(np.float32),
+            item_ids=np.arange(40, dtype=np.int64),
+            user_vectors=rng.normal(size=(20, rank)).astype(np.float32),
+        )
+        return bank
+
+    def test_reshard_parity_down_the_ladder(self):
+        """A bank built at 8 item shards re-lays onto 4 and then onto a
+        single device with identical answers and an unchanged version."""
+        ref = self._bank().build()
+        q = np.arange(5, dtype=np.int64)
+        want = ref.query(q, k=5, sources=("als",))["als"]
+        bank = self._bank().build(mesh=make_mesh(8, data=1, item=8))
+        version = bank.version
+        for mesh in (make_mesh(4, data=1, item=4), None):
+            bank.reshard(mesh)
+            got = bank.query(q, k=5, sources=("als",))["als"]
+            np.testing.assert_allclose(got[0], want[0], atol=ATOL)
+            np.testing.assert_array_equal(got[1], want[1])
+            assert bank.version == version
+
+    def test_reshard_refusal_leaves_layout_serving(self):
+        from albedo_tpu.utils.capacity import CapacityExceeded
+
+        mesh8 = make_mesh(8, data=1, item=8)
+        mesh4 = make_mesh(4, data=1, item=4)
+        bank = self._bank().build(mesh=mesh8)
+        # Per-device share doubles at the smaller rung: a budget sized for
+        # the 8-shard layout refuses the 4-shard one.
+        budget_8 = bank._retrieval_plan(mesh8, 0, 1).required_bytes
+        assert bank._retrieval_plan(mesh4, 0, 1).required_bytes > budget_8
+        with pytest.raises(CapacityExceeded):
+            bank.reshard(mesh4, budget=budget_8)
+        assert bank.mesh is mesh8  # incumbent layout untouched
+        bank.query(np.arange(3, dtype=np.int64), k=5, sources=("als",))
+
+    def test_sealed_bank_promotes_onto_smaller_rung(self):
+        """Tentpole (c): a bank built and SEALED at 8 shards promotes on 4
+        through the existing BankStage gates; the shard count is a
+        per-process layout choice, not part of the artifact."""
+        from albedo_tpu.retrieval.stage import BankStage
+
+        class _Matrix:
+            n_users = 20
+            user_ids = np.arange(20, dtype=np.int64)
+            item_ids = np.arange(40, dtype=np.int64)
+
+            def users_of(self, ids):
+                return np.asarray(ids, dtype=np.int64)
+
+        mesh8 = make_mesh(8, data=1, item=8)
+        mesh4 = make_mesh(4, data=1, item=4)
+        sealed = self._bank().build(mesh=mesh8)
+        sealed.save("elastic-bank-test.pkl", lineage={"test": True})
+        stage = BankStage(self._bank().build(mesh=mesh8), _Matrix())
+        report = stage.reload(
+            "elastic-bank-test.pkl", require_stamp=True, mesh=mesh4
+        )
+        assert report["outcome"] == "promoted", report
+        assert dict(stage.bank.mesh.shape) == {"data": 1, "item": 4}
+        q = np.arange(5, dtype=np.int64)
+        want = self._bank().build().query(q, k=5, sources=("als",))["als"]
+        got = stage.bank.query(q, k=5, sources=("als",))["als"]
+        np.testing.assert_allclose(got[0], want[0], atol=ATOL)
+        assert events.retrieval_promotions.value(outcome="promoted") == 1
+
+    def test_stage_reshard_refusal_is_recorded_not_quarantined(self, monkeypatch):
+        from albedo_tpu.retrieval.stage import BankStage
+
+        mesh8 = make_mesh(8, data=1, item=8)
+        bank = self._bank().build(mesh=mesh8)
+        stage = BankStage(bank, matrix=None)
+        budget_8 = bank._retrieval_plan(mesh8, 0, 1).required_bytes
+        monkeypatch.setenv("ALBEDO_DEVICE_MEM_BYTES", str(budget_8))
+        monkeypatch.setenv("ALBEDO_MEM_HEADROOM", "1.0")
+        out = stage.reshard(make_mesh(4, data=1, item=4))
+        assert out["outcome"] == "rejected" and out["gate"] == "capacity"
+        assert stage.bank.mesh is mesh8
+        assert events.retrieval_promotions.value(outcome="rejected") == 1
+
+    def test_serve_plans_price_per_device(self):
+        from albedo_tpu.utils import capacity
+
+        p1 = capacity.plan_serve(1000, 400, 16, excl_entries=800, n_devices=1)
+        p8 = capacity.plan_serve(1000, 400, 16, excl_entries=800, n_devices=8)
+        assert p8.required_bytes < p1.required_bytes
+        r1 = capacity.plan_retrieval([(1000, 16)], n_devices=1)
+        r8 = capacity.plan_retrieval([(1000, 16)], n_devices=8)
+        assert r8.items["embedding_tables"] < r1.items["embedding_tables"]
+
+
+# --- the acceptance drill through the real CLI ---------------------------------
+
+
+def _cli_env(data_dir: Path, devices: int, **extra: str) -> dict:
+    env = dict(os.environ)
+    env.pop("ALBEDO_FAULTS", None)
+    env.update(
+        ALBEDO_DATA_DIR=str(data_dir),
+        ALBEDO_CHECKPOINT_DIR=str(data_dir / "checkpoints"),
+        ALBEDO_TODAY="20260804",
+        JAX_PLATFORMS="cpu",
+        XLA_FLAGS=f"--xla_force_host_platform_device_count={devices}",
+        **extra,
+    )
+    return env
+
+
+def _train(env: dict, *extra_args: str) -> subprocess.CompletedProcess:
+    cmd = [
+        sys.executable, "-m", "albedo_tpu.cli", "train_als", "--small",
+        "--checkpoint-every", "2", "--mesh-devices", "8",
+        "--sharded", "streamed", *extra_args,
+    ]
+    return subprocess.run(cmd, capture_output=True, text=True, env=env, timeout=580)
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_cross_mesh_kill_resume_cli(tmp_path):
+    """ISSUE 12 acceptance: an 8-virtual-device sharded fit is HARD-KILLED
+    mid-run (ALBEDO_FAULTS kill), then resumed with only 4 visible devices
+    — the mesh remeshes down the ladder, the 8-shard checkpoint re-shards
+    onto it, and the final factors are parity-pinned at 1e-5 against the
+    uninterrupted single-device fit."""
+    import pickle
+
+    # Reference: uninterrupted SINGLE-DEVICE run in its own data dir.
+    ref_env = _cli_env(tmp_path / "ref", devices=1)
+    ref = subprocess.run(
+        [sys.executable, "-m", "albedo_tpu.cli", "train_als", "--small"],
+        capture_output=True, text=True, env=ref_env, timeout=580,
+    )
+    assert ref.returncode == 0, ref.stderr
+
+    # Chaos run: killed at the 2nd sweep-boundary checkpoint on 8 devices.
+    env = _cli_env(tmp_path / "data", devices=8)
+    killed = _train({**env, "ALBEDO_FAULTS": "checkpoint.save:kill@2"})
+    assert killed.returncode == 137, (killed.returncode, killed.stderr)
+    layouts = list((tmp_path / "data/checkpoints").rglob("layout.json"))
+    assert layouts, "the killed run left no sealed sharded checkpoints"
+    assert json.loads(layouts[0].read_text())["n_shards"] == 8
+
+    # Resume with HALF the slice: 4 visible devices against --mesh-devices 8.
+    resumed = _train(_cli_env(tmp_path / "data", devices=4), "--resume")
+    assert resumed.returncode == 0, resumed.stderr
+    assert "DEGRADED MESH" in (resumed.stderr + resumed.stdout)
+
+    def factors(d: Path):
+        path = next((d).rglob("*alsModel*.pkl"))
+        return pickle.loads(path.read_bytes())
+
+    a, b = factors(tmp_path / "data"), factors(tmp_path / "ref")
+    assert np.abs(a["user_factors"] - b["user_factors"]).max() < ATOL
+    assert np.abs(a["item_factors"] - b["item_factors"]).max() < ATOL

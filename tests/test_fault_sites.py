@@ -58,7 +58,7 @@ def test_known_sites_are_present():
         "data.validate", "train.watchdog", "pipeline.canary",
         "stream.ingest", "stream.foldin", "stream.drift",
         "capacity.admit", "mesh.devices", "als.chunked",
-        "als.shard.gather", "als.shard.stream",
+        "als.shard.gather", "als.shard.stream", "als.shard.collective",
         "retrieval.build", "retrieval.query",
     ):
         assert site in code, f"expected fault site {site!r} not found in code"

@@ -43,6 +43,36 @@ def _service(artifacts, **kw):
     return RecommendationService(model_a, matrix, repo_info=tables.repo_info, **kw)
 
 
+def test_capacity_gate_prices_per_mesh_rung(artifacts, monkeypatch):
+    """Degraded-mesh serving: a candidate affordable on the full 8-shard
+    rung is refused — recorded, not quarantined — after the ladder hands
+    this process a 1-device rung (the per-device share is 8x), and
+    `set_mesh_devices` moves the gate between rungs."""
+    from albedo_tpu.utils import capacity
+
+    tables, matrix, model_a, model_b = artifacts
+    plan_full = capacity.plan_serve(
+        matrix.n_users, matrix.n_items, model_b.rank, generations=2,
+        n_devices=8,
+    )
+    with _service(artifacts) as svc:
+        mgr = HotSwapManager(svc, probe_users=4, probe_k=K, mesh_devices=8)
+        path = _write_model("rung-alsModel.pkl", model_b)
+        monkeypatch.setenv("ALBEDO_MEM_HEADROOM", "1.0")
+        monkeypatch.setenv(
+            "ALBEDO_DEVICE_MEM_BYTES", str(plan_full.required_bytes + 4096)
+        )
+        report = mgr.request_reload(path)
+        assert report["outcome"] == "promoted", report
+        assert report["gates"]["capacity"]["mesh_devices"] == 8
+
+        mgr.set_mesh_devices(1)  # the ladder collapsed to a single device
+        path2 = _write_model("rung2-alsModel.pkl", model_b)
+        report = mgr.request_reload(path2)
+        assert report["outcome"] == "rejected" and report["gate"] == "capacity"
+        assert path2.exists() and report["quarantined_to"] is None
+
+
 def test_capacity_gate_refuses_without_quarantine(artifacts, monkeypatch):
     tables, matrix, model_a, model_b = artifacts
     with _service(artifacts) as svc:

@@ -105,9 +105,20 @@ def test_unexpected_exception_is_500_json(artifacts):
         status, _ = _get(handle, "/healthz")
         assert status == 200
         host, port = handle.server_address[:2]
-        with urllib.request.urlopen(f"http://{host}:{port}/metrics", timeout=30) as r:
-            text = r.read().decode()
-        assert 'albedo_requests_total{route="recommend",status="500"} 1' in text
+        # The request counter increments in the handler's `finally`, AFTER
+        # the response body is flushed — poll briefly so a fast scrape
+        # can't race the increment.
+        want = 'albedo_requests_total{route="recommend",status="500"} 1'
+        deadline = time.monotonic() + 2.0
+        while True:
+            with urllib.request.urlopen(
+                f"http://{host}:{port}/metrics", timeout=30
+            ) as r:
+                text = r.read().decode()
+            if want in text or time.monotonic() > deadline:
+                break
+            time.sleep(0.05)
+        assert want in text
 
 
 def test_queue_overflow_is_429_with_retry_after(artifacts):

@@ -125,7 +125,7 @@ def test_soak_smoke(monkeypatch):
     assert report["capacity_drill"]["ok"] is True
     assert report["capacity_drill"]["mode"] == "chunked"
     assert set(report["kinds_observed"]) >= {
-        "error", "ioerror", "corrupt", "delay", "oom",
+        "error", "ioerror", "corrupt", "delay", "oom", "loss",
     }
     # Every leg of every cycle reported an exit code inside the contract.
     for cycle in report["cycles"]:
@@ -145,6 +145,21 @@ def test_soak_smoke(monkeypatch):
     assert any(
         leg["fired"].get("als.shard.gather", 0) > 0 for leg in mesh_legs
     )
+    # The pinned DEVICE-LOSS cycle: its mesh leg must have run the elastic
+    # drill (injected loss survived via remesh-resume to parity) AND the
+    # degraded-serving drill (a bank sealed at the full rung promoted onto
+    # the halved rung through the real gates).
+    loss_legs = [
+        leg for leg in mesh_legs
+        if leg["fired"].get("als.shard.collective", 0) > 0
+    ]
+    assert loss_legs, "no cycle observed the als.shard.collective loss"
+    elastic = loss_legs[0]["sharded_fit"]
+    assert elastic["outcome"] == "resumed" and elastic["losses"] >= 1
+    assert elastic["max_factor_delta"] < 1e-5
+    serving = loss_legs[0]["degraded_serving"]
+    assert serving["outcome"] == "promoted"
+    assert serving["promoted_on_shards"] < serving["built_at_shards"]
     # The report is a sealed artifact-store product.
     report_path = get_settings().artifact_dir / REPORT_NAME
     assert report_path.exists()
