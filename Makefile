@@ -113,10 +113,12 @@ capacity-bench:
 retrieval-bench:
 	JAX_PLATFORMS=cpu $(PY) bench.py retrieval
 
-# ALX-scale weak scaling: the fully sharded streamed fit at 1 -> 2 -> 4 -> 8
-# chips with fixed work per chip (out-of-core synthetic star matrices),
-# per-sweep wall-clock + achieved GB/s per chip + the largest-fittable-matrix
-# estimate -> MULTICHIP_r06.json (see README "Scale runbook").
+# ALX-scale weak scaling: the fully sharded PIPELINED streamed fit at
+# 1 -> 2 -> 4 -> 8 chips with fixed work per chip (out-of-core synthetic
+# star matrices), per-sweep wall-clock + achieved GB/s per chip vs roofline
+# + per-stage overlap accounting (interleaved sync-dataflow trials) + the
+# largest-fittable-matrix estimate -> MULTICHIP_r07.json (see README
+# "Scale runbook").
 scale-bench:
 	JAX_PLATFORMS=cpu $(PY) bench.py scale
 

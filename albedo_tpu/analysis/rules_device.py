@@ -218,13 +218,19 @@ class BareJit(Rule):
 
 
 # Hot-loop roots: the training fit (resident/chunked/sharded), the LR fit,
-# the streaming fold-in, and the serving micro-batcher worker.
+# the streaming fold-in, and the serving micro-batcher worker. The pipelined
+# sharded driver loop and its background prefetch uploader are roots in
+# their own right: the uploader runs on a thread the call graph cannot
+# follow (Thread(target=...)), and a hidden sync in either would stall
+# every streamed bucket.
 DEFAULT_HOT_ROOTS: tuple[tuple[str, str], ...] = (
     ("albedo_tpu/models/als.py", "ImplicitALS.fit"),
     ("albedo_tpu/models/als.py", "ImplicitALS._fit_chunked"),
     ("albedo_tpu/models/als.py", "ImplicitALS._fit_sharded"),
     ("albedo_tpu/models/logistic_regression.py", "LogisticRegression.fit"),
     ("albedo_tpu/parallel/als.py", "ShardedALSFit.fit"),
+    ("albedo_tpu/parallel/als.py", "ShardedALSFit._half_sweep_pipelined"),
+    ("albedo_tpu/parallel/als.py", "_BucketPrefetcher._run"),
     ("albedo_tpu/streaming/foldin.py", "FoldInEngine.fold_in"),
     ("albedo_tpu/serving/batcher.py", "MicroBatcher._run"),
 )

@@ -110,6 +110,7 @@ MESH_FAULTS = (
     ("mesh.devices", "error"),
     ("als.shard.gather", "delay"),
     ("als.shard.stream", "error"),
+    ("als.shard.prefetch", "error"),
 )
 
 # Canonical per-kind evidence placements: where each kind is armed so its
@@ -189,11 +190,16 @@ def build_schedule(
     # Sharded-fit coverage: the mesh leg runs a tiny row-sharded ALS fit
     # every cycle; pin one cycle to arm its `als.shard.gather` site (delay =
     # observable and benign) so every soak — the 2-cycle smoke included —
-    # drills the sharded path's chaos surface, not just mesh boot.
+    # drills the sharded path's chaos surface, not just mesh boot. The same
+    # cycle pins `als.shard.prefetch:error` — the fault fires INSIDE the
+    # pipelined fit's background uploader thread and must surface on the
+    # consuming sweep as a CLEAN failed fit (recorded, never a hang; the
+    # wedged-thread variant is deadline-bounded and unit-drilled in
+    # tests/test_sharded_als.py).
     schedule[cycles - 1]["mesh"] = [
         (s, k, a) for s, k, a in schedule[cycles - 1]["mesh"]
-        if s != "als.shard.gather"
-    ] + [("als.shard.gather", "delay", 1)]
+        if s not in ("als.shard.gather", "als.shard.prefetch")
+    ] + [("als.shard.gather", "delay", 1), ("als.shard.prefetch", "error", 1)]
     # The device-loss cycle's elastic drill must complete via remesh-resume:
     # strip any OTHER raising als.shard.* draw from its mesh leg (the same
     # reason kill/term cycles carry only the preemption — a second injected

@@ -100,12 +100,16 @@ def main(argv: list[str] | None = None) -> int:
     )
     parser.add_argument(
         "--sharded",
-        choices=("auto", "resident", "streamed"),
+        choices=("auto", "resident", "streamed", "streamed_sync"),
         default="auto",
         help="mesh-fit shard layout (--mesh-devices > 0): auto = the "
         "capacity admission ladder picks, resident = row-sharded factor "
         "tables with device-resident buckets, streamed = additionally "
-        "stream interaction buckets from the host per half-sweep. With "
+        "stream interaction buckets from the host per half-sweep (the "
+        "PIPELINED dataflow — double-buffered prefetch, overlapped ring "
+        "phases, fused landing; ALBEDO_PIPELINE=off reverts every stage), "
+        "streamed_sync = pin the synchronous single-slab streamed dataflow "
+        "(the cheapest admission rung and the A/B triage path). With "
         "--checkpoint-every the fit runs the ELASTIC driver "
         "(parallel/elastic.py): mesh-portable sweep-boundary checkpoints, "
         "mid-fit device-loss detection, remesh-resume",

@@ -90,6 +90,96 @@ class BucketPlan:
     cap: int                 # per-row entry cap (pad length or max_len)
 
 
+def _slot_tier(n: int) -> int:
+    """Quantize a bucket's slot count: powers of two up to 1024, then
+    1024-multiples — the same tiers :func:`plan_buckets` allocates."""
+    if n > 1024:
+        return -(-n // 1024) * 1024
+    return 1 << max(0, (n - 1).bit_length())
+
+
+def coalesce_buckets(
+    buckets,
+    batch_size: int = 1024,
+    max_entries: int | None = None,
+):
+    """Stream-merge same-width partial buckets into full ones.
+
+    Out-of-core generation (``datasets.synthetic.generate_scale_dataset``)
+    packs each user chunk independently, so every length tier ends in a
+    partial bucket PER CHUNK — at n chunks the half-sweep dispatches ~n
+    buckets per tier where one would do, and per-dispatch overhead grows
+    linearly with the user count. This generator merges valid rows of
+    same-``L`` buckets as they stream past, emitting full
+    ``min(batch_size, max_entries // L)``-row buckets and flushing the
+    per-tier remainders at the end (slot counts re-quantized to the
+    planner's own tiers, so the merged shapes come from the same shape
+    universe the capacity model prices).
+
+    Numerically invisible by construction: every row keeps its exact
+    entries and pad width (only same-``L`` buckets merge), each row still
+    appears in exactly one bucket, and within-half-sweep bucket order is
+    already irrelevant to the solves — pinned by the scale-harness parity
+    tests. Host cost is one concatenation pass (~bytes of the slabs);
+    what it buys is an ~n-fold cut in dispatch count on chunked data.
+    """
+    pending: dict[int, list] = {}  # L -> [row_ids, idx, val, mask] valid-only
+
+    def build(parts, n_lo, n_hi, length, allowed):
+        """One padded bucket from pending[L] rows [n_lo:n_hi)."""
+        n = n_hi - n_lo
+        b = max(n, min(_slot_tier(n), allowed))
+        out = Bucket(
+            row_ids=np.full((b,), -1, dtype=np.int32),
+            idx=np.zeros((b, length), dtype=np.int32),
+            val=np.zeros((b, length), dtype=np.float32),
+            mask=np.zeros((b, length), dtype=bool),
+        )
+        out.row_ids[:n] = parts[0][n_lo:n_hi]
+        out.idx[:n] = parts[1][n_lo:n_hi]
+        out.val[:n] = parts[2][n_lo:n_hi]
+        out.mask[:n] = parts[3][n_lo:n_hi]
+        return out
+
+    for bk in buckets:
+        length = int(bk.idx.shape[1])
+        allowed = batch_size
+        if max_entries is not None:
+            allowed = max(1, min(batch_size, max_entries // max(1, length)))
+        valid = int((bk.row_ids >= 0).sum())  # fills front-pack valid rows
+        if length not in pending and valid == bk.row_ids.shape[0] == allowed:
+            yield bk  # already a full canonical bucket: pass through, no copy
+            continue
+        parts = pending.get(length)
+        if parts is None:
+            parts = pending[length] = [
+                bk.row_ids[:valid], bk.idx[:valid], bk.val[:valid], bk.mask[:valid]
+            ]
+        else:
+            for i, arr in enumerate(
+                (bk.row_ids[:valid], bk.idx[:valid], bk.val[:valid], bk.mask[:valid])
+            ):
+                parts[i] = np.concatenate([parts[i], arr])
+        n_have = parts[0].shape[0]
+        lo = 0
+        while n_have - lo >= allowed:
+            yield build(parts, lo, lo + allowed, length, allowed)
+            lo += allowed
+        if lo:
+            for i in range(4):
+                parts[i] = parts[i][lo:]
+            if parts[0].shape[0] == 0:
+                del pending[length]
+    for length, parts in sorted(pending.items()):
+        n = parts[0].shape[0]
+        if not n:
+            continue
+        allowed = batch_size
+        if max_entries is not None:
+            allowed = max(1, min(batch_size, max_entries // max(1, length)))
+        yield build(parts, 0, n, length, allowed)
+
+
 def plan_buckets(
     indptr: np.ndarray,
     batch_size: int = 1024,
@@ -138,14 +228,13 @@ def plan_buckets(
         while end < n_rows and end - start < allowed and eff[end] <= pad_l:
             end += 1
         n_take = end - start
-        # Slot-count tiers: powers of two up to 1024, then 1024-multiples.
-        # Pure pow-2 rounding wastes up to 2x SOLVE slots per bucket once
-        # batches are wide (measured +20% padded entries at batch_size=8192);
+        # Slot-count tiers (`_slot_tier`, ONE definition — the streaming
+        # coalescer re-quantizes merged buckets through the same rule):
+        # powers of two up to 1024, then 1024-multiples. Pure pow-2
+        # rounding wastes up to 2x SOLVE slots per bucket once batches are
+        # wide (measured +20% padded entries at batch_size=8192);
         # 1024-steps bound slot waste at ~12% with a still-small shape count.
-        if n_take > 1024:
-            b = -(-n_take // 1024) * 1024
-        else:
-            b = 1 << max(0, (n_take - 1).bit_length())
+        b = _slot_tier(n_take)
         # Never exceed the caller's slot budget (or entry budget): tier
         # rounding quantizes shapes but must not grow the bucket past them.
         b = max(n_take, min(b, allowed))

@@ -131,26 +131,98 @@ class ScaleDataset:
         sub = {"user": "user-buckets", "item": "item-buckets"}[side]
         return sorted((self.root / sub).glob("*.npz"))
 
-    def iter_buckets(self, side: str):
-        """Yield the stored padded buckets for one half-sweep, file by file
-        — never more than one file's buckets in memory."""
+    @staticmethod
+    def _load_bucket_file(path: Path) -> list:
         from albedo_tpu.datasets.ragged import Bucket
 
-        for path in self._bucket_files(side):
-            with np.load(path) as z:
-                n = int(z["n_buckets"])
-                for i in range(n):
-                    yield Bucket(
-                        row_ids=z[f"b{i}_row_ids"],
-                        idx=z[f"b{i}_idx"],
-                        val=z[f"b{i}_val"],
-                        mask=z[f"b{i}_mask"],
-                    )
+        with np.load(path) as z:
+            n = int(z["n_buckets"])
+            return [
+                Bucket(
+                    row_ids=z[f"b{i}_row_ids"],
+                    idx=z[f"b{i}_idx"],
+                    val=z[f"b{i}_val"],
+                    mask=z[f"b{i}_mask"],
+                )
+                for i in range(n)
+            ]
 
-    def provider(self, side: str):
+    def iter_buckets(
+        self,
+        side: str,
+        readahead: bool | None = None,
+        coalesce: bool = False,
+    ):
+        """Yield the stored padded buckets for one half-sweep, file by file.
+
+        With ``readahead`` (default: the ``ALBEDO_PIPELINE`` switch) the
+        NEXT file is read and parsed on a background thread while the
+        current file's buckets are consumed — the disk I/O side of the
+        pipelined sharded dataflow, feeding the device-side bucket
+        prefetcher (``parallel.als._BucketPrefetcher``) without ever making
+        it wait on a cold ``np.load``. Peak host memory is ONE file's
+        buckets synchronous, TWO under readahead (the double-buffer's host
+        half). ``readahead=False`` restores the strictly one-file-resident
+        synchronous walk; bucket order is identical either way.
+
+        ``coalesce`` stream-merges each length tier's per-chunk partial
+        buckets into full ones (``datasets.ragged.coalesce_buckets``):
+        chunked generation fragments every tier once per chunk file, so an
+        n-chunk dataset otherwise dispatches ~n buckets where one would
+        do. Raw (False) is the stored layout — what the meta shapes
+        describe; :meth:`provider` turns coalescing on for fits under the
+        pipeline switch.
+        """
+        if readahead is None:
+            from albedo_tpu.utils.dataflow import pipeline_enabled
+
+            readahead = pipeline_enabled()
+        if coalesce:
+            from albedo_tpu.datasets.ragged import coalesce_buckets
+
+            yield from coalesce_buckets(
+                self.iter_buckets(side, readahead=readahead, coalesce=False),
+                batch_size=int(self.meta.get("batch_size", 1024)),
+                max_entries=self.meta.get("max_entries"),
+            )
+            return
+        files = self._bucket_files(side)
+        if not readahead:
+            for path in files:
+                yield from self._load_bucket_file(path)
+            return
+        from concurrent.futures import ThreadPoolExecutor
+
+        with ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="albedo-bucket-read"
+        ) as pool:
+            pending = pool.submit(self._load_bucket_file, files[0]) if files else None
+            for i in range(len(files)):
+                buckets = pending.result()
+                pending = (
+                    pool.submit(self._load_bucket_file, files[i + 1])
+                    if i + 1 < len(files) else None
+                )
+                yield from buckets
+
+    def provider(
+        self,
+        side: str,
+        readahead: bool | None = None,
+        coalesce: bool | None = None,
+    ):
         """A re-callable bucket provider for ``ShardedALSFit.fit`` — each
-        half-sweep re-streams the side's buckets from disk."""
-        return lambda: self.iter_buckets(side)
+        half-sweep re-streams the side's buckets from disk. Defaults follow
+        the ``ALBEDO_PIPELINE`` switch: file readahead on a background
+        thread AND per-tier bucket coalescing (see :meth:`iter_buckets`) —
+        the host half of the pipelined sharded dataflow."""
+        if coalesce is None:
+            from albedo_tpu.utils.dataflow import pipeline_enabled
+
+            coalesce = pipeline_enabled()
+        return lambda: self.iter_buckets(
+            side, readahead=readahead, coalesce=coalesce
+        )
 
     def bucket_shapes(self, side: str) -> list[tuple[int, int]]:
         return [tuple(s) for s in self.meta[f"{side}_bucket_shapes"]]

@@ -252,11 +252,15 @@ class ImplicitALS:
     chunked: bool | None = None
     # Mesh-path admission (requires self.mesh): None = the admission LADDER
     # decides — replicated-resident -> sharded tables -> sharded + streamed
-    # buckets; False forces the replicated GSPMD path; "resident"/True force
-    # row-sharded tables with resident buckets; "streamed" additionally
-    # streams interaction buckets from the host per half-sweep (the star
-    # matrix is never device-resident whole). Checkpointed mesh fits run
-    # the ELASTIC driver (parallel/elastic.py): mesh-portable sweep-boundary
+    # buckets (double-buffered prefetch) -> sharded + streamed synchronous
+    # (single bucket in flight); False forces the replicated GSPMD path;
+    # "resident"/True force row-sharded tables with resident buckets;
+    # "streamed" additionally streams interaction buckets from the host per
+    # half-sweep (the star matrix is never device-resident whole) through
+    # the PIPELINED dataflow (ALBEDO_PIPELINE governs); "streamed_sync"
+    # pins the synchronous streamed dataflow — the cheaper admission rung
+    # and the A/B triage path. Checkpointed mesh fits run the ELASTIC
+    # driver (parallel/elastic.py): mesh-portable sweep-boundary
     # checkpoints + mid-fit device-loss remesh-resume.
     sharded: Any | None = None
     # Source-factor assembly for the sharded path: "allgather" (full table
@@ -503,12 +507,19 @@ class ImplicitALS:
         """Admission ladder for the mesh path (closes the PR 7 'mesh path
         exempt' blind spot): replicated-resident GSPMD fit -> row-sharded
         tables with resident sharded buckets -> sharded + host-streamed
-        buckets. Each rung is priced PER DEVICE; the first rung that fits
-        the budget wins (``verdict.chosen``). When even the streamed rung
-        busts the budget, raises :class:`~albedo_tpu.utils.capacity.
+        buckets under the pipelined dataflow (TWO bucket slabs in flight —
+        the double-buffered prefetch) -> sharded + streamed SYNCHRONOUS
+        (one slab in flight; the pipeline is worth a slab of HBM, so the
+        ladder may trade it away before refusing). Each rung is priced PER
+        DEVICE; the first rung that fits the budget wins
+        (``verdict.chosen``). When even the synchronous streamed rung busts
+        the budget, raises :class:`~albedo_tpu.utils.capacity.
         CapacityExceeded` — that matrix needs more chips, not more spilling.
+        With ``ALBEDO_PIPELINE=off`` the streamed rung prices (and runs)
+        the single-slab synchronous dataflow directly.
         """
         from albedo_tpu.parallel.mesh import DATA_AXIS
+        from albedo_tpu.utils.dataflow import pipeline_enabled
 
         n_dev = int(self.mesh.shape[DATA_AXIS])
         shapes_u, shapes_i = self._plan_shapes(matrix)
@@ -517,13 +528,21 @@ class ImplicitALS:
             gather_dtype=self.gather_dtype, mode=self.shard_mode,
             solver=self.solver,
         )
-        verdict = capacity_mod.admit_ladder([
+        pipelined = pipeline_enabled()
+        plans = [
             capacity_mod.plan_fit(
                 *args, gather_dtype=self.gather_dtype, n_devices=n_dev
             ),
             capacity_mod.plan_fit_sharded(*args, n_dev, streamed=False, **shard_kw),
-            capacity_mod.plan_fit_sharded(*args, n_dev, streamed=True, **shard_kw),
-        ])
+            capacity_mod.plan_fit_sharded(
+                *args, n_dev, streamed=True, pipelined=pipelined, **shard_kw
+            ),
+        ]
+        if pipelined:
+            plans.append(capacity_mod.plan_fit_sharded(
+                *args, n_dev, streamed=True, pipelined=False, **shard_kw
+            ))
+        verdict = capacity_mod.admit_ladder(plans)
         if verdict.verdict == "refuse":
             raise capacity_mod.CapacityExceeded(verdict)
         return verdict
@@ -577,11 +596,16 @@ class ImplicitALS:
                         "als_fit": False,
                         "als_fit_sharded": "resident",
                         "als_fit_sharded_streamed": "streamed",
+                        "als_fit_sharded_streamed_sync": "streamed_sync",
                     }[admission.chosen]
             if sharded:
                 return self._fit_sharded(
                     matrix, callback, admission, t0,
-                    streamed=(sharded == "streamed"),
+                    streamed=(sharded in ("streamed", "streamed_sync")),
+                    # "streamed_sync" is the admission ladder's single-slab
+                    # rung (or forced triage): the synchronous dataflow.
+                    # Everything else defers to the ALBEDO_PIPELINE switch.
+                    pipelined=False if sharded == "streamed_sync" else None,
                 )
         ug, ig, u_land, i_land = self.device_groups(matrix)
         prep_split = dict(getattr(self, "last_prep_timings", {}))
@@ -830,17 +854,21 @@ class ImplicitALS:
         admission,
         t0: float,
         streamed: bool,
+        pipelined: bool | None = None,
     ) -> ALSModel:
         """The ALX-layout fit: BOTH factor tables row-sharded over the
         mesh's data axis, per-device bucket blocks solved against
         all-gathered (or ring-passed) source shards inside shard_map, and —
         when ``streamed`` — interaction buckets uploaded per half-sweep so
-        the star matrix is never device-resident whole. Same kernels as
-        every other path (``ops.als.bucket_solve_body``/``bucket_cg_body``
-        via ``parallel.als.ShardedALSFit``), per-shape executables through
-        the persistent AOT layer, and the watchdog health reduction as the
-        completion barrier — parity with the single-device resident fit is
-        test-pinned at atol 1e-5.
+        the star matrix is never device-resident whole. The dataflow is
+        PIPELINED by default (double-buffered bucket prefetch, overlapped
+        ring phases, fused landing scatter — ``ALBEDO_PIPELINE=off`` or
+        ``pipelined=False`` reverts to the synchronous PR 8 dataflow). Same
+        kernels as every other path (``ops.als.bucket_solve_body``/
+        ``bucket_cg_body`` via ``parallel.als.ShardedALSFit``), per-shape
+        executables through the persistent AOT layer, and the watchdog
+        health reduction as the completion barrier — parity with the
+        single-device resident fit is test-pinned at atol 1e-5.
         """
         from albedo_tpu.parallel.als import sharded_fit_engine
         from albedo_tpu.parallel.mesh import DATA_AXIS
@@ -867,7 +895,7 @@ class ImplicitALS:
         user_f, item_f, stats = engine.fit(
             user_f, item_f, user_buckets, item_buckets,
             self.reg_param, self.alpha, self.max_iter,
-            streamed=streamed, callback=callback,
+            streamed=streamed, callback=callback, pipelined=pipelined,
         )
 
         from albedo_tpu.utils.watchdog import factor_health, health_dict
@@ -892,6 +920,13 @@ class ImplicitALS:
             "capacity": None if admission is None else admission.to_dict(),
             "streamed_buckets": stats["streamed_buckets"],
             "sharded_shapes": stats["n_shapes"],
+            # Pipelined-dataflow accounting: upload_s accumulates inside the
+            # background prefetch thread when pipelined+streamed, so it is
+            # OFF the critical path there; prefetch_wait_s is the time the
+            # sweep actually stalled waiting for a bucket — the visible
+            # (un-hidden) remainder of the upload cost.
+            "pipelined": stats["pipelined"],
+            "prefetch_wait_s": stats["prefetch_wait_s"],
             # Elasticity cost surface: a bare sharded fit observed no mesh
             # events; the elastic driver (parallel/elastic.py) overwrites
             # this with its loss/resume/checkpoint record.

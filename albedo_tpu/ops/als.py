@@ -47,6 +47,18 @@ def gramian(factors: jax.Array) -> jax.Array:
     return factors.T @ factors
 
 
+def scatter_solved(
+    target: jax.Array, row_ids: jax.Array, solved: jax.Array
+) -> jax.Array:
+    """Land a solved block into ``target``: padding slots (``row_ids == -1``)
+    scatter out of bounds and drop. One definition of the landing contract —
+    shared by the per-bucket reference path, the chunked host-streamed path,
+    and the scan fallback; the sharded landing (``parallel.als.
+    _landing_scatter``) is the owner-shard variant of the same rule."""
+    safe_rows = jnp.where(row_ids < 0, target.shape[0], row_ids)
+    return target.at[safe_rows].set(solved, mode="drop")
+
+
 def _gather(source: jax.Array, idx: jax.Array, gather_dtype) -> jax.Array:
     """Row-gather the fixed side's factors, optionally through a reduced-
     precision copy of the table.
@@ -228,9 +240,7 @@ def solve_bucket(
     """One normal-equation solve for a padded bucket of rows; returns updated
     ``target`` with solved rows scattered in."""
     solved = bucket_solve_body(source, yty, idx, val, mask, reg, alpha)
-    # Padding slots scatter out of bounds and are dropped.
-    safe_rows = jnp.where(row_ids < 0, target.shape[0], row_ids)
-    return target.at[safe_rows].set(solved, mode="drop")
+    return scatter_solved(target, row_ids, solved)
 
 
 @functools.partial(
@@ -270,8 +280,7 @@ def chunked_bucket_update(
         solved = bucket_solve_body(
             source, yty, idx, val, mask, reg, alpha, gather_dtype=gather_dtype,
         )
-    safe_rows = jnp.where(row_ids < 0, target.shape[0], row_ids)
-    return target.at[safe_rows].set(solved, mode="drop")
+    return scatter_solved(target, row_ids, solved)
 
 
 def als_half_sweep(
@@ -361,8 +370,7 @@ def scan_half_sweep(
         return pool[landing]
     rows = jnp.concatenate(all_rows)
     solved = jnp.concatenate(all_solved)
-    safe_rows = jnp.where(rows < 0, target.shape[0], rows)
-    return target.at[safe_rows].set(solved, mode="drop")
+    return scatter_solved(target, rows, solved)
 
 
 def _fit_loop(

@@ -49,6 +49,20 @@ background while the driver remeshes. On a real slice the wedged backend
 is unusable anyway and the remesh targets the surviving devices; on the
 CPU simulator an injected ``delay`` simply finishes harmlessly after the
 remesh has moved on.
+
+Pipelined streamed fits (the default dataflow — ARCHITECTURE.md
+"Pipelined sharded dataflow") need no extra machinery here, by design: a
+device loss with a PREFETCHED bucket in flight drains cleanly to the last
+sweep boundary because (a) the prefetcher is a context manager inside the
+chunk's fit — when the loss propagates, it stops its uploader thread and
+drops the in-flight device bucket on the way out — and (b) the chunk's
+half-applied factor tables are discarded whole: the remesh re-runs the
+chunk from the boundary checkpoint, so no half-applied bucket can survive
+into the resumed state (parity-pinned in ``tests/test_elastic.py``). A
+WEDGED prefetch thread is bounded by the same collective deadline at the
+prefetcher's own queue wait (``parallel.als.PrefetchStalled``, a plain
+non-loss failure: remeshing cannot revive a host-side reader) with this
+driver's chunk deadline as the backstop.
 """
 
 from __future__ import annotations
@@ -159,6 +173,9 @@ _CHOSEN_TO_MODE = {
     "als_fit": "resident",
     "als_fit_sharded": "resident",
     "als_fit_sharded_streamed": "streamed",
+    # The admission ladder's cheapest rung: streamed with the pipelined
+    # double-buffer traded away (one bucket slab in flight).
+    "als_fit_sharded_streamed_sync": "streamed_sync",
 }
 
 
@@ -211,9 +228,9 @@ def elastic_sharded_fit(
     if every < 1:
         raise ValueError(f"checkpoint interval must be >= 1, got {every}")
     deadline = collective_deadline_s() if deadline_s is None else float(deadline_s)
-    forced = est.sharded if est.sharded in ("resident", "streamed") else (
-        "resident" if est.sharded is True else None
-    )
+    forced = est.sharded if est.sharded in (
+        "resident", "streamed", "streamed_sync"
+    ) else ("resident" if est.sharded is True else None)
     orig_est = est
 
     ckpt = ShardedStepCheckpointer(directory, keep_last=keep_last)

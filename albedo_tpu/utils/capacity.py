@@ -408,18 +408,26 @@ def plan_fit_sharded(
     streamed: bool = False,
     mode: str = "allgather",
     solver: str = "cholesky",
+    pipelined: bool = True,
 ) -> CapacityPlan:
     """Price the fully sharded ALS fit (ALX layout), PER DEVICE.
 
     Resident: 1/n of BOTH row-sharded factor tables, plus (non-streamed)
-    1/n of every bucket slab. Streamed mode keeps only the single largest
-    bucket's slab shard in flight — the star matrix is never device-resident
-    whole. Transient, per bucket: the assembled source factors — the FULL
-    (padded) table under ``mode="allgather"``, a double-buffered 1/n shard
-    ring slot under ``mode="ring"`` — plus the local gathered block, its
-    Gramian correction, and the all-gathered solved rows of the bucket. The
-    CG solver additionally all-gathers the target table for its warm-start
-    rows, so its transient prices BOTH tables under all-gather.
+    1/n of every bucket slab. Streamed mode keeps only the in-flight bucket
+    slab shards on device — the star matrix is never device-resident whole:
+    under the default PIPELINED dataflow the double-buffered prefetch holds
+    **two** bucket slabs at once (the one being solved plus the one the
+    background uploader just landed), priced as the worst same-side pair of
+    slab shards — both in-flight buckets always belong to one half-sweep;
+    ``pipelined=False`` is the synchronous dataflow's single slab — which is
+    why the admission ladder can pick unpipelined-streamed as a cheaper rung
+    below pipelined-streamed. Transient, per bucket: the assembled source
+    factors — the FULL (padded) table under ``mode="allgather"``, a
+    double-buffered 1/n shard ring slot under ``mode="ring"`` — plus the
+    local gathered block, its Gramian correction, and the all-gathered
+    solved rows of the bucket. The CG solver additionally all-gathers the
+    target table for its warm-start rows, so its transient prices BOTH
+    tables under all-gather.
     """
     gb = _dtype_bytes(gather_dtype)
     n = max(1, int(n_devices))
@@ -427,6 +435,7 @@ def plan_fit_sharded(
     tables = (u_pad + i_pad) * rank * 4 // n
     slabs = 0
     worst_slab = 0
+    worst_pair = 0
     transient = 0
     for shapes, src_rows, tgt_rows in (
         (bucket_shapes_user, i_pad, u_pad),  # user solves gather item factors
@@ -439,28 +448,41 @@ def plan_fit_sharded(
             assembled = src_rows * rank * gb
             if solver == "cg":
                 assembled += tgt_rows * rank * 4  # warm-start gather
+        side_worst = side_second = 0
         for b, ln in shapes:
             slab = b * 4 + b * ln * (4 + 4 + 1)
             slabs += slab // n
             worst_slab = max(worst_slab, slab // n)
+            if slab // n >= side_worst:
+                side_worst, side_second = slab // n, side_worst
+            elif slab // n > side_second:
+                side_second = slab // n
             local = (
                 (b // n) * ln * (rank * gb + gb)
                 + (b // n) * rank * rank * 4
                 + b * rank * 4  # all-gathered solved rows land on every device
             )
             transient = max(transient, assembled + local)
+        # The double-buffer only ever holds buckets of ONE half-sweep, so
+        # the pipelined in-flight peak is the worst SAME-SIDE pair (a
+        # one-bucket side never double-buffers itself).
+        worst_pair = max(worst_pair, side_worst + side_second)
     items = {
         "factor_table_shards": tables,
         "transient_assembly": transient,
     }
-    if streamed:
+    workload = "als_fit_sharded"
+    if streamed and pipelined:
+        # Double-buffered prefetch: the bucket being solved + the one the
+        # background uploader holds — the two largest slabs of one side.
+        items["streamed_slabs_in_flight"] = worst_pair
+        workload = "als_fit_sharded_streamed"
+    elif streamed:
         items["streamed_slab_in_flight"] = worst_slab
+        workload = "als_fit_sharded_streamed_sync"
     else:
         items["bucket_slab_shards"] = slabs
-    return CapacityPlan(
-        workload="als_fit_sharded_streamed" if streamed else "als_fit_sharded",
-        items=items,
-    )
+    return CapacityPlan(workload=workload, items=items)
 
 
 def plan_fit_chunked(

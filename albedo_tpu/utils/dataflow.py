@@ -1,0 +1,24 @@
+"""The pipelined-dataflow switch.
+
+The sharded ALS dataflow is pipelined by default (double-buffered bucket
+prefetch, overlapped ring collectives, fused landing scatter — see
+ARCHITECTURE.md "Pipelined sharded dataflow"). ``ALBEDO_PIPELINE=off``
+reverts every stage to the synchronous PR 8 dataflow in one flip — the A/B
+and triage path: if a pipelined fit ever misbehaves, the first move is to
+re-run with the pipeline off and diff.
+
+Kept in a dependency-free module (no jax import) so host-only layers — the
+out-of-core dataset reader, the capacity planner's callers — can consult
+the same switch the device driver uses.
+"""
+
+from __future__ import annotations
+
+import os
+
+PIPELINE_ENV = "ALBEDO_PIPELINE"
+
+
+def pipeline_enabled() -> bool:
+    """Whether the pipelined sharded dataflow is on (default: yes)."""
+    return os.environ.get(PIPELINE_ENV, "on").lower() not in ("off", "0", "false")

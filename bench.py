@@ -1773,16 +1773,29 @@ def scale_bench() -> dict:
     through the row-sharded fit (``parallel.als.ShardedALSFit``, both factor
     tables sharded, ``streamed=True`` so the star matrix is never
     device-resident whole), and reports the median per-sweep wall-clock plus
-    the achieved streamed GB/s per chip from the explicit bytes model. Ideal
+    the achieved streamed GB/s per chip from the explicit bytes model
+    against the 285 GB/s measured-roofline reference (BENCH_r05). Ideal
     weak scaling is a FLAT per-sweep curve; ``efficiency`` = t(1 chip) /
-    t(n chips). The record also carries the largest-fittable-matrix estimate
-    per mode from the ``plan_fit_sharded`` cost model against the detected
-    per-device budget, and is written to MULTICHIP_r06.json
-    (``ALBEDO_SCALE_OUT`` overrides the path).
+    t(n chips).
 
-    Env knobs: ALBEDO_SCALE_USERS_PER_CHIP/ITEMS/MEAN_STARS/RANK/SWEEPS/
-    DEVICES/MODE/SOLVER/HOST_DEVICES/OUT. Defaults are CPU-smoke sized; a
-    TPU slice runs the same scenario with real chips and 10M-row shards.
+    The dataflow under test is the PIPELINED one (prefetch + overlapped
+    ring + fused landing); each rung interleaves synchronous-dataflow trials
+    (the SNIPPETS per-scheme ``simple_timeit`` pattern: same warmed
+    executables, scheme alternated per trial) and reports the per-stage
+    overlap accounting — upload-hidden fraction (how much of the upload cost
+    the prefetch hid off the critical path) and the pipeline gain vs sync —
+    plus a ring-phase overlap probe at the max device count. Both schemes
+    are warmed EXPLICITLY until executable acquisition reports zero compile
+    seconds, and compile time is reported separately (the r06 record's
+    3-trial median could still land on the compile-bearing first trial —
+    the 0.3167/0.0738/0.0677 spread — masking overlap wins). A scheme
+    parity gate (1e-5) pins pipelined == synchronous factors per rung.
+
+    The record lands in MULTICHIP_r07.json (``ALBEDO_SCALE_OUT`` overrides
+    the path). Env knobs: ALBEDO_SCALE_USERS_PER_CHIP/ITEMS/MEAN_STARS/
+    RANK/SWEEPS/DEVICES/MODE/SOLVER/HOST_DEVICES/OUT. Defaults are
+    CPU-smoke sized; a TPU slice runs the same scenario with real chips and
+    10M-row shards.
     """
     import statistics
     import tempfile
@@ -1831,6 +1844,11 @@ def scale_bench() -> dict:
     if not counts:
         fail("scale", f"no requested device count fits the {visible} visible")
 
+    # The measured single-chip HBM roofline (BENCH_r05: the fused resident
+    # sweep ran at 0.82 of it): the reference the streamed path's achieved
+    # GB/s per chip is judged against.
+    ROOFLINE_GBPS = 285.0
+
     gb = 4  # f32 gathers on this scenario
     curve = []
     for n in counts:
@@ -1851,22 +1869,71 @@ def scale_bench() -> dict:
             uf = rng.normal(0, scale0, (n_users, rank)).astype(np.float32)
             vf = rng.normal(0, scale0, (n_items, rank)).astype(np.float32)
 
-            # Warmup sweep compiles every bucket-shape executable.
-            engine.fit(uf, vf, ds.provider("user"), ds.provider("item"),
-                       0.5, 40.0, 1, streamed=True)
-            per_sweep = []
+            # The two schemes under test: the PIPELINED dataflow (background
+            # file readahead + per-tier bucket coalescing + double-buffered
+            # prefetch + overlapped collectives + fused landing) vs the
+            # fully SYNCHRONOUS PR 8 dataflow (raw stored buckets, one
+            # upload + one dispatch at a time).
+            prov_pipe = (ds.provider("user"), ds.provider("item"))
+            prov_sync = (
+                ds.provider("user", readahead=False, coalesce=False),
+                ds.provider("item", readahead=False, coalesce=False),
+            )
+
+            # Warm EXPLICITLY, per scheme, until executable acquisition is
+            # quiet — trials must never bear (or subtract around) compile
+            # time; it is reported separately below.
+            warm = {"warm_sweeps": 0, "warmup_compile_s": 0.0}
+            for pipelined, (pu, pi) in ((True, prov_pipe), (False, prov_sync)):
+                for _ in range(4):
+                    _, _, wstats = engine.fit(
+                        uf, vf, pu, pi,
+                        0.5, 40.0, 1, streamed=True, pipelined=pipelined,
+                    )
+                    warm["warm_sweeps"] += 1
+                    warm["warmup_compile_s"] += wstats["compile_s"]
+                    if wstats["compile_s"] == 0.0:
+                        break
+            warm["warmup_compile_s"] = round(warm["warmup_compile_s"], 4)
+
+            # Interleaved per-scheme trials (simple_timeit pattern): the
+            # pipelined dataflow vs the synchronous one, alternating so
+            # machine drift hits both schemes equally.
+            per_sweep, sync_sweep = [], []
+            upload_s = wait_s = 0.0
+            sync_out = None
             for _ in range(max(1, sweeps)):
                 t0 = time.perf_counter()
                 u_out, i_out, stats = engine.fit(
-                    uf, vf, ds.provider("user"), ds.provider("item"),
-                    0.5, 40.0, 1, streamed=True,
+                    uf, vf, prov_pipe[0], prov_pipe[1],
+                    0.5, 40.0, 1, streamed=True, pipelined=True,
                 )
                 # The watchdog health read is the completion barrier.
                 health = health_dict(factor_health(u_out, i_out))
-                per_sweep.append(time.perf_counter() - t0 - stats["compile_s"])
+                per_sweep.append(time.perf_counter() - t0)
+                upload_s += stats["upload_s"]
+                wait_s += stats["prefetch_wait_s"]
+                t0 = time.perf_counter()
+                su, si, _ = engine.fit(
+                    uf, vf, prov_sync[0], prov_sync[1],
+                    0.5, 40.0, 1, streamed=True, pipelined=False,
+                )
+                health_dict(factor_health(su, si))  # completion barrier
+                sync_sweep.append(time.perf_counter() - t0)
+                sync_out = (su, si)
             if health["nonfinite"]:
                 fail("scale", f"non-finite factors at {n} devices")
+            # Scheme parity gate: the pipelined dataflow must land the
+            # synchronous dataflow's factors exactly (1e-5).
+            delta = max(
+                float(np.abs(np.asarray(u_out) - np.asarray(sync_out[0])).max()),
+                float(np.abs(np.asarray(i_out) - np.asarray(sync_out[1])).max()),
+            )
+            if delta > 1e-5:
+                fail("scale", f"pipelined/sync parity {delta} at {n} devices")
             sweep_s = statistics.median(per_sweep)
+            sync_s = statistics.median(sync_sweep)
+            n_trials = max(1, sweeps)
 
             # Elasticity cost: what ONE mesh-portable sweep-boundary
             # checkpoint of this rung's factor tables costs (the elastic
@@ -1885,12 +1952,19 @@ def scale_bench() -> dict:
 
             # Explicit per-chip bytes model for one full sweep (both halves):
             # streamed slab upload + the local gathered block traffic + the
-            # assembled source tables + the solved-row all-gathers.
+            # assembled source tables + the solved-row all-gathers. Priced
+            # from the shapes the PIPELINED sweep actually dispatches (the
+            # provider coalesces chunk-fragmented buckets), not the raw
+            # stored layout — the timed run and the bytes it is divided by
+            # must describe the same dataflow.
             u_pad = -(-n_users // n) * n
             i_pad = -(-n_items // n) * n
             bytes_chip = 0
             for side, src_pad in (("user", i_pad), ("item", u_pad)):
-                shapes = ds.bucket_shapes(side)
+                shapes = [
+                    b.shape
+                    for b in ds.iter_buckets(side, readahead=False, coalesce=True)
+                ]
                 slab = sum(b * 4 + b * ln * 9 for b, ln in shapes)
                 gathered = sum(b * ln for b, ln in shapes) * (rank * gb + gb)
                 solved = sum(b for b, _ in shapes) * rank * 4
@@ -1899,6 +1973,7 @@ def scale_bench() -> dict:
                 # receives it as n shard visits of table/n bytes each.
                 assembled = len(shapes) * src_pad * rank * gb
                 bytes_chip += (slab + gathered) // n + solved + assembled
+            gbps = bytes_chip / max(sweep_s, 1e-9) / 1e9
             curve.append({
                 "n_devices": n,
                 "n_users": n_users,
@@ -1906,8 +1981,25 @@ def scale_bench() -> dict:
                 "nnz": ds.nnz,
                 "per_sweep_s": round(sweep_s, 4),
                 "per_sweep_trials": [round(t, 4) for t in per_sweep],
-                "achieved_gbps_per_chip": round(bytes_chip / max(sweep_s, 1e-9) / 1e9, 3),
+                "achieved_gbps_per_chip": round(gbps, 3),
+                "roofline_frac": round(gbps / ROOFLINE_GBPS, 5),
                 "streamed_buckets_per_sweep": stats["streamed_buckets"],
+                "compile": dict(warm),
+                # Per-stage overlap accounting: how much of the per-sweep
+                # cost the pipeline moved off the critical path.
+                "overlap": {
+                    "sync_per_sweep_s": round(sync_s, 4),
+                    "sync_per_sweep_trials": [round(t, 4) for t in sync_sweep],
+                    "pipeline_gain_frac": round(1.0 - sweep_s / max(sync_s, 1e-9), 4),
+                    "upload_s_per_sweep": round(upload_s / n_trials, 4),
+                    "prefetch_wait_s_per_sweep": round(wait_s / n_trials, 4),
+                    # 1 - (time the sweep stalled on the prefetcher) /
+                    # (time the uploads actually took in the background):
+                    # 1.0 = every upload fully hidden behind compute.
+                    "upload_hidden_frac": round(
+                        max(0.0, 1.0 - wait_s / upload_s), 4
+                    ) if upload_s > 0 else None,
+                },
                 "mesh_events": {
                     "degradations": int(events.mesh_degraded.total() - deg_before),
                     "losses": int(events.mesh_losses.total() - loss_before),
@@ -1922,6 +2014,51 @@ def scale_bench() -> dict:
     base_s = curve[0]["per_sweep_s"]
     for row in curve:
         row["efficiency_vs_1chip"] = round(base_s / max(row["per_sweep_s"], 1e-9), 3)
+
+    # Ring-phase overlap probe at the max device count: one in-memory
+    # resident fit per scheme (no streaming, so upload noise is excluded —
+    # this isolates the ppermute-ahead-of-compute reorder), simple_timeit
+    # style medians over the warmed executables.
+    from albedo_tpu.datasets.synthetic import synthetic_stars
+
+    n_dev = counts[-1]
+    ring_probe = {"n_devices": n_dev}
+    try:
+        from albedo_tpu.models.als import ImplicitALS
+
+        pm = synthetic_stars(
+            n_users=max(256, users_per_chip), n_items=n_items,
+            mean_stars=mean_stars, seed=7,
+        )
+        ring_engine = ShardedALSFit(make_mesh(n_dev), solver="cholesky", mode="ring")
+        est = ImplicitALS(rank=rank, max_iter=1, batch_size=1024, seed=0)
+        ub, ib = est._host_buckets(pm)
+        rng = np.random.default_rng(3)
+        s0 = 1.0 / np.sqrt(rank)
+        pu = rng.normal(0, s0, (pm.n_users, rank)).astype(np.float32)
+        pv = rng.normal(0, s0, (pm.n_items, rank)).astype(np.float32)
+        timings = {}
+        for scheme, pipelined in (("overlapped", True), ("sync", False)):
+            for _ in range(2):  # warm the scheme's executables
+                ring_engine.fit(pu, pv, ub, ib, 0.5, 40.0, 1, pipelined=pipelined)
+            trials = []
+            for _ in range(max(3, sweeps)):
+                t0 = time.perf_counter()
+                ru, ri, _ = ring_engine.fit(
+                    pu, pv, ub, ib, 0.5, 40.0, 1, pipelined=pipelined
+                )
+                health_dict(factor_health(ru, ri))  # completion barrier
+                trials.append(time.perf_counter() - t0)
+            timings[scheme] = statistics.median(trials)
+        ring_probe.update({
+            "overlapped_per_sweep_s": round(timings["overlapped"], 4),
+            "sync_per_sweep_s": round(timings["sync"], 4),
+            "phase_overlap_gain_frac": round(
+                1.0 - timings["overlapped"] / max(timings["sync"], 1e-9), 4
+            ),
+        })
+    except Exception as e:  # noqa: BLE001 — the probe must not sink the record
+        ring_probe["error"] = repr(e)[-200:]
 
     # Largest-fittable-matrix estimate: walk the user count up until the
     # streamed sharded plan busts the detected per-device budget, with a
@@ -1958,6 +2095,9 @@ def scale_bench() -> dict:
     forced_virtual = "xla_force_host_platform_device_count" in os.environ.get(
         "XLA_FLAGS", ""
     )
+    from albedo_tpu.utils.dataflow import pipeline_enabled
+
+    pipeline_on = pipeline_enabled()
     record = {
         "metric": "sharded_als_weak_scaling",
         "unit": "per-sweep wall-clock s at max device count (weak scaling)",
@@ -1970,6 +2110,9 @@ def scale_bench() -> dict:
         ) if forced_virtual and jax.default_backend() == "cpu" else
         "real devices: efficiency_vs_1chip is the weak-scaling figure",
         "weak_scaling": curve,
+        "roofline_gbps_per_chip": ROOFLINE_GBPS,
+        "pipeline": "on" if pipeline_on else "off",
+        "ring_overlap_probe": ring_probe,
         "largest_fittable": largest,
         "mode": mode,
         "solver": solver,
@@ -1981,7 +2124,7 @@ def scale_bench() -> dict:
     }
     out_path = os.environ.get(
         "ALBEDO_SCALE_OUT",
-        os.path.join(os.path.dirname(os.path.abspath(__file__)), "MULTICHIP_r06.json"),
+        os.path.join(os.path.dirname(os.path.abspath(__file__)), "MULTICHIP_r07.json"),
     )
     try:
         with open(out_path, "w") as f:

@@ -140,10 +140,12 @@ def test_retrieval_scenario_record_shape(monkeypatch):
 @pytest.mark.slow
 def test_scale_scenario_record_shape(monkeypatch, tmp_path):
     """Micro-size run of the `scale` weak-scaling scenario: the record must
-    carry the full curve (per-sweep wall-clock, GB/s per chip, efficiency),
-    the largest-fittable estimates for both assembly modes, and land in
-    MULTICHIP_r06.json."""
-    out = tmp_path / "MULTICHIP_r06.json"
+    carry the full curve (per-sweep wall-clock, GB/s per chip vs roofline,
+    efficiency), the per-stage overlap accounting (explicit warm + separate
+    compile reporting, upload-hidden fraction, interleaved sync trials, the
+    ring-phase probe), the largest-fittable estimates for both assembly
+    modes, and land in MULTICHIP_r07.json."""
+    out = tmp_path / "MULTICHIP_r07.json"
     monkeypatch.setenv("ALBEDO_SCALE_USERS_PER_CHIP", "200")
     monkeypatch.setenv("ALBEDO_SCALE_ITEMS", "100")
     monkeypatch.setenv("ALBEDO_SCALE_MEAN_STARS", "5")
@@ -156,8 +158,19 @@ def test_scale_scenario_record_shape(monkeypatch, tmp_path):
     for row in rec["weak_scaling"]:
         assert row["per_sweep_s"] > 0
         assert row["achieved_gbps_per_chip"] > 0
+        assert 0 <= row["roofline_frac"] <= 1
         assert row["streamed_buckets_per_sweep"] > 0
         assert row["n_users"] == 200 * row["n_devices"]  # fixed work per chip
+        # Compile is warmed out of the trials and reported separately —
+        # a trial median can never land on a compile-bearing sweep.
+        assert row["compile"]["warm_sweeps"] >= 2
+        assert row["compile"]["warmup_compile_s"] >= 0
+        ov = row["overlap"]
+        assert ov["sync_per_sweep_s"] > 0
+        assert ov["upload_s_per_sweep"] >= 0
+        assert ov["prefetch_wait_s_per_sweep"] >= 0
+        if ov["upload_hidden_frac"] is not None:
+            assert 0 <= ov["upload_hidden_frac"] <= 1
         # Elasticity cost is visible, not silent: per-rung mesh events +
         # the measured sweep-boundary checkpoint overhead.
         me = row["mesh_events"]
@@ -165,6 +178,12 @@ def test_scale_scenario_record_shape(monkeypatch, tmp_path):
         assert me["checkpoint_s"] > 0
         assert me["checkpoint_overhead_frac_per_sweep"] >= 0
     assert rec["weak_scaling"][0]["efficiency_vs_1chip"] == 1.0
+    assert rec["roofline_gbps_per_chip"] == 285.0
+    assert rec["pipeline"] == "on"
+    probe = rec["ring_overlap_probe"]
+    assert "error" in probe or (
+        probe["overlapped_per_sweep_s"] > 0 and probe["sync_per_sweep_s"] > 0
+    )
     for mode in ("allgather", "ring"):
         assert rec["largest_fittable"][mode]["max_users"] > 0
     assert json.loads(out.read_text())["metric"] == "sharded_als_weak_scaling"

@@ -110,13 +110,15 @@ class TestShardedPlans:
         p8 = capacity.plan_fit_sharded(self.SHAPES, self.SHAPES, 4000, 2000, 16, 8)
         assert p8.required_bytes < p1.required_bytes
 
-    def test_streamed_keeps_one_slab_in_flight(self):
+    def test_streamed_sync_keeps_one_slab_in_flight(self):
         resident = capacity.plan_fit_sharded(
             self.SHAPES, self.SHAPES, 4000, 2000, 16, 8, streamed=False
         )
         streamed = capacity.plan_fit_sharded(
-            self.SHAPES, self.SHAPES, 4000, 2000, 16, 8, streamed=True
+            self.SHAPES, self.SHAPES, 4000, 2000, 16, 8, streamed=True,
+            pipelined=False,
         )
+        assert streamed.workload == "als_fit_sharded_streamed_sync"
         assert streamed.required_bytes < resident.required_bytes
         assert "streamed_slab_in_flight" in streamed.items
         assert "bucket_slab_shards" in resident.items
@@ -124,6 +126,51 @@ class TestShardedPlans:
             streamed.items["streamed_slab_in_flight"]
             < resident.items["bucket_slab_shards"]
         )
+
+    def test_pipelined_streamed_prices_two_slabs_in_flight(self):
+        """The double-buffered prefetch holds the bucket being solved AND
+        the one the background uploader just landed: the pipelined-streamed
+        rung prices the two LARGEST slab shards, strictly more than the
+        synchronous single slab and strictly less than two copies of the
+        worst (the two in-flight buckets are distinct buckets)."""
+        sync = capacity.plan_fit_sharded(
+            self.SHAPES, self.SHAPES, 4000, 2000, 16, 8, streamed=True,
+            pipelined=False,
+        )
+        piped = capacity.plan_fit_sharded(
+            self.SHAPES, self.SHAPES, 4000, 2000, 16, 8, streamed=True,
+            pipelined=True,
+        )
+        assert piped.workload == "als_fit_sharded_streamed"
+        assert "streamed_slabs_in_flight" in piped.items
+        worst = sync.items["streamed_slab_in_flight"]
+        assert worst < piped.items["streamed_slabs_in_flight"] <= 2 * worst
+        # Everything else prices identically: the pipeline costs exactly
+        # one extra in-flight slab, nothing hidden.
+        assert piped.items["factor_table_shards"] == sync.items["factor_table_shards"]
+        assert piped.items["transient_assembly"] == sync.items["transient_assembly"]
+
+    def test_ladder_ordering_pipelined_above_sync(self):
+        """The admission ladder's degradation order holds: resident >
+        pipelined-streamed > synchronous-streamed, so a budget squeezed
+        between the last two picks unpipelined-streamed as the cheaper
+        rung instead of refusing."""
+        resident = capacity.plan_fit_sharded(
+            self.SHAPES, self.SHAPES, 4000, 2000, 16, 8, streamed=False
+        )
+        piped = capacity.plan_fit_sharded(
+            self.SHAPES, self.SHAPES, 4000, 2000, 16, 8, streamed=True
+        )
+        sync = capacity.plan_fit_sharded(
+            self.SHAPES, self.SHAPES, 4000, 2000, 16, 8, streamed=True,
+            pipelined=False,
+        )
+        assert sync.required_bytes < piped.required_bytes < resident.required_bytes
+        verdict = capacity.admit_ladder(
+            [resident, piped, sync], budget=sync.required_bytes + 1
+        )
+        assert verdict.verdict == "degrade"
+        assert verdict.chosen == "als_fit_sharded_streamed_sync"
 
     def test_ring_transient_below_allgather(self):
         # Ring never materializes a full table: at large table sizes its

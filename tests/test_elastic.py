@@ -277,6 +277,29 @@ class TestElasticFit:
         assert journal["status"] == "complete"
         assert journal["mesh_events"]["losses"] == 1
 
+    def test_loss_with_prefetched_bucket_in_flight_drains_to_boundary(
+        self, matrix, reference, tmp_path
+    ):
+        """The pipelined-dataflow drill: the fit streams buckets through
+        the background prefetcher (double-buffered — a bucket IS in flight
+        when the collective dies at the head of the second half-sweep).
+        The loss must drain cleanly to the last sweep boundary: prefetcher
+        stopped, in-flight bucket dropped, chunk re-run whole after the
+        remesh — NO half-applied bucket, proven by exact parity with the
+        uninterrupted reference."""
+        faults.arm("als.shard.collective", kind="loss", at=2)
+        est = ImplicitALS(**KW, mesh=make_mesh(8), sharded="streamed")
+        model = elastic_sharded_fit(est, matrix, tmp_path, every=2)
+        _parity(model, reference)
+        rep = est.last_fit_report
+        assert rep["pipelined"] is True
+        # The prefetch surface really was active when the loss hit.
+        assert faults.FAULTS.hits("als.shard.prefetch") > 0
+        me = rep["mesh_events"]
+        assert me["losses"] == 1 and me["resumes"] == 1
+        journal = json.loads((tmp_path / "journal.json").read_text())
+        assert journal["status"] == "complete"
+
     def test_hung_collective_trips_the_deadline(self, matrix, reference, tmp_path):
         """A HUNG (not dead) shard: an injected delay overruns the
         collective deadline, classifies as lost, and the fit remeshes and

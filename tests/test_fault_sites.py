@@ -59,6 +59,6 @@ def test_known_sites_are_present():
         "stream.ingest", "stream.foldin", "stream.drift",
         "capacity.admit", "mesh.devices", "als.chunked",
         "als.shard.gather", "als.shard.stream", "als.shard.collective",
-        "retrieval.build", "retrieval.query",
+        "als.shard.prefetch", "retrieval.build", "retrieval.query",
     ):
         assert site in code, f"expected fault site {site!r} not found in code"

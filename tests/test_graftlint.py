@@ -241,6 +241,10 @@ def test_aot_fed_names_see_the_real_surface():
         "_gather_topk", "_gather_topk_device_excl", "_foldin_solve",
         "make_sharded_update", "_lbfgs_fit_jit", "_lbfgs_fit_many_jit",
         "_block_logits_jit", "epoch_jit", "run_jit",
+        # The pipelined sharded dataflow's programs flow through the
+        # _acquire_executable conduit into the AOT layer.
+        "make_pipelined_solve", "make_pipelined_landsolve",
+        "make_landing_flush",
     ):
         assert name in fed, f"{name} not recognized as AOT-fed"
 
@@ -254,6 +258,14 @@ def test_hot_loop_reachability_sees_the_real_surface():
     assert ("albedo_tpu/models/als.py", "ImplicitALS.fit") in reached
     assert ("albedo_tpu/serving/batcher.py", "MicroBatcher._execute") in reached
     assert ("albedo_tpu/streaming/foldin.py", "FoldInEngine._solve_chunk") in reached
+    # The pipelined driver loop and the background prefetch uploader are
+    # hot roots themselves (the uploader runs on a thread the call graph
+    # cannot follow), and the driver's bucket path is reachable.
+    assert (
+        "albedo_tpu/parallel/als.py", "ShardedALSFit._half_sweep_pipelined"
+    ) in reached
+    assert ("albedo_tpu/parallel/als.py", "_BucketPrefetcher._run") in reached
+    assert ("albedo_tpu/parallel/als.py", "ShardedALSFit.put_bucket") in reached
     # Cross-module edge through a function-local import.
     assert ("albedo_tpu/ops/als.py", "gramian") in reached
 

@@ -88,6 +88,125 @@ class TestParity:
             ShardedALSFit(mesh8, solver="cg", mode="ring")
 
 
+class TestPipelinedDataflow:
+    """The pipelined dataflow (double-buffered prefetch, overlapped ring
+    phases, fused landing scatter) is numerically IDENTICAL to the
+    synchronous PR 8 dataflow — the parity matrix pins streamed-pipelined
+    vs streamed-synchronous vs resident against the single-device fit."""
+
+    def _engine_fit(self, mesh8, matrix, mode="allgather", solver="cholesky",
+                    streamed=True, pipelined=True, init=None):
+        est = ImplicitALS(**KW, solver=solver, shard_mode=mode, mesh=mesh8)
+        eng = ShardedALSFit(mesh8, solver=solver, mode=mode)
+        if init is None:
+            import jax as _jax
+            import jax.numpy as _jnp
+            ukey, ikey = _jax.random.split(_jax.random.PRNGKey(KW["seed"]))
+            scale = 1.0 / np.sqrt(KW["rank"])
+            init = (
+                np.asarray(_jax.random.normal(
+                    ukey, (matrix.n_users, KW["rank"]), _jnp.float32) * scale),
+                np.asarray(_jax.random.normal(
+                    ikey, (matrix.n_items, KW["rank"]), _jnp.float32) * scale),
+            )
+        ub, ib = est._host_buckets(matrix)
+        u, v, stats = eng.fit(
+            init[0], init[1], ub, ib, est.reg_param, est.alpha, KW["max_iter"],
+            streamed=streamed, pipelined=pipelined,
+        )
+        return np.asarray(u), np.asarray(v), stats
+
+    @pytest.mark.parametrize("mode", ["allgather", "ring"])
+    def test_streamed_pipelined_matches_sync_and_resident(
+        self, mesh8, matrix, reference, mode
+    ):
+        for streamed, pipelined in ((True, True), (True, False), (False, True)):
+            u, v, stats = self._engine_fit(
+                mesh8, matrix, mode=mode, streamed=streamed, pipelined=pipelined
+            )
+            np.testing.assert_allclose(u, reference.user_factors, atol=ATOL)
+            np.testing.assert_allclose(v, reference.item_factors, atol=ATOL)
+            assert stats["pipelined"] is pipelined
+
+    def test_cg_pipelined_matches_single_device(self, mesh8, matrix):
+        rng = np.random.default_rng(0)
+        init = (
+            rng.normal(0, 0.1, (matrix.n_users, KW["rank"])).astype(np.float32),
+            rng.normal(0, 0.1, (matrix.n_items, KW["rank"])).astype(np.float32),
+        )
+        ref = ImplicitALS(**KW, solver="cg", init_factors=init, chunked=False).fit(matrix)
+        u, v, _ = self._engine_fit(
+            mesh8, matrix, solver="cg", streamed=True, pipelined=True, init=init
+        )
+        np.testing.assert_allclose(u, ref.user_factors, atol=ATOL)
+        np.testing.assert_allclose(v, ref.item_factors, atol=ATOL)
+
+    def test_streamed_default_is_pipelined_with_prefetch(self, mesh8, matrix, reference):
+        est = ImplicitALS(**KW, mesh=mesh8, sharded="streamed")
+        model = est.fit(matrix)
+        rep = est.last_fit_report
+        assert rep["pipelined"] is True
+        assert rep["streamed_buckets"] > 0
+        # Uploads happened in the background thread; the sweep's stall time
+        # is recorded separately from the (hidden) upload time.
+        assert rep["prefetch_wait_s"] >= 0
+        assert faults.FAULTS.hits("als.shard.prefetch") > 0
+        _parity(model, reference)
+
+    def test_streamed_sync_mode_reachable_for_triage(self, mesh8, matrix, reference):
+        before = faults.FAULTS.hits("als.shard.prefetch")
+        est = ImplicitALS(**KW, mesh=mesh8, sharded="streamed_sync")
+        model = est.fit(matrix)
+        rep = est.last_fit_report
+        assert rep["mode"] == "sharded_streamed"
+        assert rep["pipelined"] is False
+        # The synchronous path never touches the prefetch surface.
+        assert faults.FAULTS.hits("als.shard.prefetch") == before
+        _parity(model, reference)
+
+    def test_env_off_switch_reverts_to_sync(self, mesh8, matrix, reference, monkeypatch):
+        monkeypatch.setenv("ALBEDO_PIPELINE", "off")
+        before = faults.FAULTS.hits("als.shard.prefetch")
+        est = ImplicitALS(**KW, mesh=mesh8, sharded="streamed")
+        model = est.fit(matrix)
+        assert est.last_fit_report["pipelined"] is False
+        assert faults.FAULTS.hits("als.shard.prefetch") == before
+        _parity(model, reference)
+
+
+class TestPrefetchFaultSite:
+    def test_prefetch_error_surfaces_as_clean_failed_fit(self, mesh8, matrix):
+        # at=2: the first bucket prefetches fine, the SECOND dies in the
+        # background uploader — the error must be delivered to the
+        # consuming sweep and fail the fit cleanly, never hang it.
+        faults.arm("als.shard.prefetch", kind="error", at=2)
+        est = ImplicitALS(**KW, mesh=mesh8, sharded="streamed")
+        with pytest.raises(faults.FaultInjected):
+            est.fit(matrix)
+        assert faults.FAULTS.fired("als.shard.prefetch") == 1
+
+    def test_prefetch_silent_on_resident_path(self, mesh8, matrix, reference):
+        faults.arm("als.shard.prefetch", kind="error", at=1)
+        model = ImplicitALS(**KW, mesh=mesh8, sharded=True).fit(matrix)
+        assert faults.FAULTS.fired("als.shard.prefetch") == 0
+        _parity(model, reference)
+
+    def test_wedged_prefetch_bounded_by_collective_deadline(
+        self, mesh8, matrix, monkeypatch
+    ):
+        """A prefetch thread stuck longer than the collective deadline must
+        surface as PrefetchStalled — a clean failed fit, never a hang. The
+        injected delay out-sleeps a shrunk deadline, exactly the
+        wedged-uploader shape."""
+        from albedo_tpu.parallel.als import PrefetchStalled
+
+        monkeypatch.setenv("ALBEDO_COLLECTIVE_DEADLINE_S", "0.2")
+        faults.arm("als.shard.prefetch", kind="delay", at=1, param=2.0)
+        est = ImplicitALS(**KW, mesh=mesh8, sharded="streamed")
+        with pytest.raises(PrefetchStalled, match="collective deadline"):
+            est.fit(matrix)
+
+
 class TestFaultSites:
     def test_gather_fault_fails_the_fit(self, mesh8, matrix):
         faults.arm("als.shard.gather", kind="error", at=1)
