@@ -12,7 +12,8 @@ JOBS = popularity curation content train_als cv_als build_user_profile \
 
 .PHONY: $(JOBS) test test-all bench serve-bench datacheck-bench chaos \
         chaos-serve chaos-stream chaos-elastic stream stream-bench dryrun \
-        soak soak-smoke capacity-bench retrieval-bench lint lint-baseline
+        soak soak-smoke capacity-bench retrieval-bench lint lint-baseline \
+        sanitize
 
 $(JOBS):
 	$(PY) -m albedo_tpu.cli $@ $(ARGS)
@@ -33,6 +34,22 @@ lint:
 # diff: shrinking is progress, growth needs a reason in the PR.
 lint-baseline:
 	$(PY) -m albedo_tpu.analysis --write-baseline
+
+# The runtime complement of graftlint's concurrency tier (R6-R8): re-run
+# the threaded suites (micro-batcher, hot-swap reload, breakers, elastic,
+# locksmith's own drills) plus the soak smoke leg with the locksmith
+# lock-order sanitizer armed (ALBEDO_LOCKCHECK=1). Every lock created via
+# analysis.locksmith.named_lock is tracked per thread; an ABBA inversion,
+# a self-deadlock, or an unguarded shared access fails the run and counts
+# in albedo_lockcheck_violations_total{kind=}. See ARCHITECTURE.md
+# "Concurrency".
+sanitize:
+	JAX_PLATFORMS=cpu ALBEDO_LOCKCHECK=1 $(PY) -m pytest \
+	  tests/test_locksmith.py tests/test_serving_batcher.py \
+	  tests/test_serving_reload.py tests/test_serving_breaker.py \
+	  tests/test_elastic.py -q -m 'not slow'
+	JAX_PLATFORMS=cpu ALBEDO_LOCKCHECK=1 $(PY) -m pytest \
+	  tests/test_soak.py -q -m chaos
 
 test-all:
 	$(PY) -m pytest tests/ -q

@@ -25,11 +25,34 @@ catalog):
   explicit f32 accumulation (``preferred_element_type``).
 - ``retrace-hazard`` (R5): jitted functions whose Python branches read
   traced parameters, or whose static arguments default to unhashables.
+- ``shared-state-guard`` (R6): instance attributes / module globals
+  written in one thread context and touched from another without a common
+  lock, a synchronization primitive, or a reasoned pragma. Thread contexts
+  come from the call graph's static thread-root discovery
+  (``Thread(target=...)``, executor ``submit``/``map``), which also feeds
+  R2 its derived hot roots.
+- ``lock-discipline`` (R7): mutex acquisition only via ``with``; locks
+  created through ``analysis.locksmith.named_lock`` so the runtime
+  sanitizer can wrap them; nested acquisition must match the
+  ARCHITECTURE.md lock-order catalog both directions; worker threads
+  spawn ``daemon=True``.
+- ``executor-lifecycle`` (R8): every spawned thread/executor has a
+  context-managed, joined, or handed-off shutdown path; threads are named
+  and matched against the ARCHITECTURE.md thread inventory both
+  directions.
+
+The runtime complement is :mod:`albedo_tpu.analysis.locksmith`: under
+``ALBEDO_LOCKCHECK=1`` every ``named_lock`` mutex is wrapped to record
+per-thread acquisition order, detect ABBA inversions / self-deadlocks /
+unguarded shared access, and count violations in
+``albedo_lockcheck_violations_total`` — run via ``make sanitize`` and
+checked as a standing invariant by the chaos soak.
 
 Mechanics: ``# albedo: noqa[rule-id]`` pragmas suppress a finding at its
 line (with a reason — pragmas are documentation); ``.graftlint-baseline.json``
 grandfathers findings that predate a rule; ``python -m albedo_tpu.analysis``
-is the CLI (``--json`` for machines, ``--write-baseline`` to re-baseline).
+is the CLI (``--json`` for machines, ``--write-baseline`` to re-baseline,
+``--no-cache`` to skip the warm-run parse cache).
 """
 
 from albedo_tpu.analysis.core import (  # noqa: F401
@@ -44,8 +67,7 @@ from albedo_tpu.analysis.core import (  # noqa: F401
     load_baseline,
     write_baseline,
 )
-# Importing the rule modules registers them.
-from albedo_tpu.analysis import rules_device  # noqa: F401
-from albedo_tpu.analysis import rules_contract  # noqa: F401
-from albedo_tpu.analysis import rules_dtype  # noqa: F401
-from albedo_tpu.analysis import rules_retrace  # noqa: F401
+# Rule modules are imported (and thereby registered) by core.all_rules()
+# on first use — NOT here: production modules import
+# `albedo_tpu.analysis.locksmith` for `named_lock` at startup, and that
+# must stay a stdlib-only import, not a tour of the whole lint tier.

@@ -3,19 +3,30 @@
 Deliberately static and conservative: edges are resolved only where the
 import structure makes the target unambiguous (same-module functions,
 ``self.method`` within a class, ``from pkg.mod import name`` /
-``import pkg.mod as m`` targets inside the analyzed package). Unresolvable
-calls (stdlib, numpy, dynamic dispatch) simply have no edge — a rule built
-on this graph under-approximates reachability rather than drowning the
-tree in false positives.
+``import pkg.mod as m`` targets inside the analyzed package, and class
+instantiations -> ``__init__``). Unresolvable calls (stdlib, numpy, dynamic
+dispatch) simply have no edge — a rule built on this graph
+under-approximates reachability rather than drowning the tree in false
+positives.
+
+The graph also discovers **thread spawn sites** statically —
+``threading.Thread(target=...)``, ``threading.Timer``, and
+``submit``/``map`` on names bound to a ``ThreadPoolExecutor`` — because the
+call graph cannot follow execution onto a thread by itself: ``target=f``
+is a reference, not a call. :func:`discover_thread_spawns` feeds three
+consumers: R2's hot-loop reachability (a thread spawned from a hot
+function is hot), R6's shared-state contexts, and R7/R8's
+lifecycle/inventory checks.
 """
 
 from __future__ import annotations
 
 import ast
 import dataclasses
+import re
 from typing import Iterator
 
-from albedo_tpu.analysis.core import Module, ProjectTree, dotted_name
+from albedo_tpu.analysis.core import Module, ProjectTree, dotted_name, last_segment
 
 
 @dataclasses.dataclass
@@ -37,6 +48,10 @@ class CallGraph:
         self.tree = tree
         # (module relpath, qualname) -> FunctionInfo
         self.functions: dict[tuple[str, str], FunctionInfo] = {}
+        # (module relpath, class name) — instantiation calls resolve to the
+        # class's __init__ so reachability follows object construction
+        # (the prefetcher's Thread spawn lives in its __init__).
+        self.classes: set[tuple[str, str]] = set()
         # module relpath -> {local name: (kind, target)} where kind is
         # "module" (target = module relpath) or "symbol"
         # (target = (module relpath, symbol name)).
@@ -80,43 +95,52 @@ class CallGraph:
                     # qualname only when reached via the outer body walk in
                     # callees() — they are not independently addressable.
                 elif isinstance(child, ast.ClassDef) and class_name is None:
+                    self.classes.add((rel, child.name))
                     index_def(child, child.name)
 
         index_def(mod.tree, None)
 
     # ----------------------------------------------------------- resolution
+    def _lookup(self, rel: str, name: str) -> FunctionInfo | None:
+        """A bare name in ``rel``: same-module function, same-module class
+        (-> its ``__init__``), or an imported symbol resolving to either."""
+        hit = self.functions.get((rel, name))
+        if hit is not None:
+            return hit
+        if (rel, name) in self.classes:
+            return self.functions.get((rel, f"{name}.__init__"))
+        imp = self.imports.get(rel, {}).get(name)
+        if imp and imp[0] == "symbol":
+            target_mod, sym = imp[1]  # type: ignore[misc]
+            hit = self.functions.get((target_mod, sym))
+            if hit is not None:
+                return hit
+            if (target_mod, sym) in self.classes:
+                return self.functions.get((target_mod, f"{sym}.__init__"))
+        return None
+
+    def resolve_ref(
+        self, rel: str, class_name: str | None, expr: ast.AST
+    ) -> FunctionInfo | None:
+        """Resolve a *reference* (not a call): ``f``, ``self.method``, or
+        ``mod.f`` — the shape of a ``Thread(target=...)`` argument."""
+        if isinstance(expr, ast.Name):
+            return self._lookup(rel, expr.id)
+        if isinstance(expr, ast.Attribute):
+            base = expr.value
+            if isinstance(base, ast.Name) and base.id == "self" and class_name:
+                return self.functions.get((rel, f"{class_name}.{expr.attr}"))
+            dn = dotted_name(base)
+            if dn is not None:
+                imp = self.imports.get(rel, {}).get(dn.split(".")[0])
+                if imp and imp[0] == "module":
+                    return self.functions.get((imp[1], expr.attr))  # type: ignore[arg-type]
+        return None
+
     def resolve_call(
         self, caller: FunctionInfo, call: ast.Call
     ) -> FunctionInfo | None:
-        func = call.func
-        rel = caller.module
-        imports = self.imports.get(rel, {})
-        if isinstance(func, ast.Name):
-            name = func.id
-            hit = self.functions.get((rel, name))
-            if hit is not None:
-                return hit
-            imp = imports.get(name)
-            if imp and imp[0] == "symbol":
-                target_mod, sym = imp[1]  # type: ignore[misc]
-                return self.functions.get((target_mod, sym))
-            return None
-        if isinstance(func, ast.Attribute):
-            base = func.value
-            if isinstance(base, ast.Name) and base.id == "self" and caller.class_name:
-                return self.functions.get(
-                    (rel, f"{caller.class_name}.{func.attr}")
-                )
-            dn = dotted_name(base)
-            if dn is not None:
-                imp = imports.get(dn.split(".")[0])
-                if imp and imp[0] == "module":
-                    return self.functions.get((imp[1], func.attr))  # type: ignore[arg-type]
-                # `from albedo_tpu import ops` style: dn = "ops.als" etc. —
-                # covered above only for single-segment bases; deeper chains
-                # stay unresolved (conservative).
-            return None
-        return None
+        return self.resolve_ref(caller.module, caller.class_name, call.func)
 
     def callees(self, fn: FunctionInfo) -> Iterator[FunctionInfo]:
         for node in ast.walk(fn.node):
@@ -150,3 +174,238 @@ class CallGraph:
                     seen[key] = callee
                     frontier.append(callee)
         return list(seen.values())
+
+
+# --- thread-root discovery ----------------------------------------------------
+
+_EXECUTOR_CTORS = {"ThreadPoolExecutor", "ProcessPoolExecutor"}
+_SPAWN_METHODS = {"submit", "map"}
+
+
+def _threading_aliases(mod_tree: ast.Module) -> dict[str, str]:
+    """Local names bound to threading.Thread/Timer via ``from threading
+    import Thread [as T]`` — bare ``Thread(...)``/``Timer(...)`` calls only
+    count as spawns through such a binding (the repo's profiling ``Timer``
+    must not look like a thread)."""
+    aliases: dict[str, str] = {}
+    for node in ast.walk(mod_tree):
+        if isinstance(node, ast.ImportFrom) and node.module == "threading":
+            for alias in node.names:
+                if alias.name in ("Thread", "Timer"):
+                    aliases[alias.asname or alias.name] = alias.name
+    return aliases
+
+
+@dataclasses.dataclass(frozen=True)
+class ThreadSpawn:
+    """One statically-discovered spawn site.
+
+    ``kind`` is ``thread`` / ``timer`` / ``executor`` (an ``Executor``
+    construction site; its ``submit``/``map`` calls resolve targets but the
+    lifecycle obligations attach to the pool). ``target`` is the resolved
+    ``(module, qualname)`` the spawned execution enters, or ``None`` when
+    the reference is dynamic (lambda, bound method of a local object) — a
+    lambda's calls are already walked as part of its enclosing function, so
+    an unresolved target loses nothing for reachability. ``encl`` is the
+    nearest *addressable* enclosing function, i.e. where the spawn happens.
+    """
+
+    module: str
+    line: int
+    col: int
+    kind: str
+    target: tuple[str, str] | None
+    target_repr: str
+    daemon: bool | None          # the `daemon=` kwarg; None = not passed
+    name: str | None             # the `name=` kwarg (f-strings -> <name>)
+    bound_to: str | None         # variable/attribute the object is bound to
+    encl: tuple[str, str] | None
+    encl_class: str | None
+    context_managed: bool = False  # the ctor IS a `with` item
+
+
+def _const_kwarg(call: ast.Call, key: str):
+    for kw in call.keywords:
+        if kw.arg == key and isinstance(kw.value, ast.Constant):
+            return kw.value.value
+    return None
+
+
+def _name_kwarg(call: ast.Call) -> str | None:
+    for kw in call.keywords:
+        if kw.arg != "name":
+            continue
+        if isinstance(kw.value, ast.Constant) and isinstance(kw.value.value, str):
+            return kw.value.value
+        if isinstance(kw.value, ast.JoinedStr):
+            parts = []
+            for piece in kw.value.values:
+                parts.append(
+                    str(piece.value) if isinstance(piece, ast.Constant)
+                    else "<name>"
+                )
+            return re.sub(r"\{[^}]*\}", "<name>", "".join(parts))
+    return None
+
+
+def _executor_bound_names(mod_tree: ast.Module) -> set[str]:
+    """Bare names (variables or attribute tails) bound to an Executor via
+    assignment or a ``with ... as x`` item — the receivers whose
+    ``.submit``/``.map`` calls count as spawns."""
+    bound: set[str] = set()
+    for node in ast.walk(mod_tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            if last_segment(node.value.func) in _EXECUTOR_CTORS:
+                for tgt in node.targets:
+                    name = last_segment(tgt)
+                    if name:
+                        bound.add(name)
+        elif isinstance(node, ast.With) or isinstance(node, ast.AsyncWith):
+            for item in node.items:
+                if (
+                    isinstance(item.context_expr, ast.Call)
+                    and last_segment(item.context_expr.func) in _EXECUTOR_CTORS
+                    and item.optional_vars is not None
+                ):
+                    name = last_segment(item.optional_vars)
+                    if name:
+                        bound.add(name)
+    return bound
+
+
+def discover_thread_spawns(
+    tree: ProjectTree, graph: CallGraph | None = None
+) -> list[ThreadSpawn]:
+    """Every statically-visible spawn site in the project, in file order."""
+    from albedo_tpu.analysis.core import walk_with_stack
+
+    graph = graph if graph is not None else CallGraph(tree)
+    spawns: list[ThreadSpawn] = []
+
+    for rel, mod in tree.modules.items():
+        executors = _executor_bound_names(mod.tree)
+        threading_names = _threading_aliases(mod.tree)
+
+        def visit(node: ast.AST, stack: tuple[ast.AST, ...]) -> None:
+            if not isinstance(node, ast.Call):
+                return
+            # Enclosing addressable function + class, from the stack.
+            encl: tuple[str, str] | None = None
+            encl_class: str | None = None
+            cls: str | None = None
+            for anc in stack:
+                if isinstance(anc, ast.ClassDef):
+                    cls = anc.name
+                elif isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    qual = f"{cls}.{anc.name}" if cls else anc.name
+                    if (rel, qual) in graph.functions:
+                        encl = (rel, qual)
+                        encl_class = cls
+            if encl_class is None:
+                encl_class = cls
+
+            dn = dotted_name(node.func)
+            ctor = None
+            if dn == "threading.Thread":
+                ctor = "Thread"
+            elif dn == "threading.Timer":
+                ctor = "Timer"
+            elif dn in threading_names:
+                ctor = threading_names[dn]
+            kind = target_expr = None
+            if ctor == "Thread":
+                kind = "thread"
+                for kw in node.keywords:
+                    if kw.arg == "target":
+                        target_expr = kw.value
+            elif ctor == "Timer":
+                kind = "timer"
+                if len(node.args) >= 2:
+                    target_expr = node.args[1]
+                for kw in node.keywords:
+                    if kw.arg == "function":
+                        target_expr = kw.value
+            elif last_segment(node.func) in _EXECUTOR_CTORS:
+                kind = "executor"
+            elif (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in _SPAWN_METHODS
+                and last_segment(node.func.value) in executors
+                and node.args
+            ):
+                kind = "executor-task"
+                target_expr = node.args[0]
+            if kind is None:
+                return
+
+            target = None
+            if target_expr is not None and not isinstance(
+                target_expr, ast.Lambda
+            ):
+                hit = graph.resolve_ref(rel, encl_class, target_expr)
+                if hit is not None:
+                    target = (hit.module, hit.qualname)
+
+            bound = None
+            managed = False
+            for anc in reversed(stack):
+                if isinstance(anc, ast.Assign) and anc.value is node:
+                    bound = last_segment(anc.targets[0]) if anc.targets else None
+                    break
+                if isinstance(anc, (ast.With, ast.AsyncWith)):
+                    for item in anc.items:
+                        if item.context_expr is node:
+                            managed = True
+                            if item.optional_vars is not None:
+                                bound = last_segment(item.optional_vars)
+                    break
+
+            spawns.append(ThreadSpawn(
+                module=rel, line=node.lineno, col=node.col_offset, kind=kind,
+                target=target,
+                target_repr=(
+                    dotted_name(target_expr) or "<dynamic>"
+                    if target_expr is not None else "<none>"
+                ),
+                daemon=(
+                    bool(_const_kwarg(node, "daemon"))
+                    if _const_kwarg(node, "daemon") is not None else None
+                ),
+                name=_name_kwarg(node),
+                bound_to=bound,
+                encl=encl, encl_class=encl_class,
+                context_managed=managed,
+            ))
+
+        walk_with_stack(mod.tree, visit)
+
+    return spawns
+
+
+def derived_thread_roots(
+    tree: ProjectTree,
+    base_roots: Iterator[tuple[str, str]] | list[tuple[str, str]],
+    graph: CallGraph | None = None,
+) -> list[tuple[str, str]]:
+    """Thread targets reachable *by spawning* from ``base_roots``: a spawn
+    site enclosed in a function reachable from the roots contributes its
+    resolved target as a new root, to fixpoint (a thread may spawn
+    threads). This is how R2's hot-loop reachability follows execution
+    onto the prefetcher thread without hand-listing it."""
+    graph = graph if graph is not None else tree.callgraph()
+    spawns = [s for s in tree.thread_spawns() if s.target]
+    roots = [r for r in base_roots if r in graph.functions]
+    known = set(roots)
+    derived: list[tuple[str, str]] = []
+    while True:
+        reach = {(f.module, f.qualname) for f in graph.reachable(roots + derived)}
+        added = False
+        for sp in spawns:
+            if sp.target in known or sp.encl is None:
+                continue
+            if sp.encl in reach:
+                derived.append(sp.target)
+                known.add(sp.target)
+                added = True
+        if not added:
+            return derived

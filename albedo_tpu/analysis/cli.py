@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 from pathlib import Path
 
@@ -40,6 +41,12 @@ def main(argv: list[str] | None = None) -> int:
         help="comma-separated rule ids (default: all)",
     )
     parser.add_argument("--json", action="store_true", help="machine output")
+    parser.add_argument(
+        "--no-cache", action="store_true",
+        help="re-parse every file (default: warm runs reuse the "
+             ".graftlint-cache.pkl mtime+size-keyed parse cache; "
+             "ALBEDO_LINT_CACHE=0 also disables it)",
+    )
     parser.add_argument(
         "--baseline", default=None,
         help=f"baseline file (default: <root>/{BASELINE_NAME})",
@@ -83,7 +90,10 @@ def main(argv: list[str] | None = None) -> int:
     if not root.is_dir():
         print(f"not a directory: {root}", file=sys.stderr)
         return 2
-    tree = ProjectTree.load(root)
+    use_cache = not args.no_cache and os.environ.get(
+        "ALBEDO_LINT_CACHE", "1"
+    ).lower() not in ("0", "false", "off")
+    tree = ProjectTree.load(root, cache=use_cache)
     findings = collect_findings(tree, rule_ids=rule_ids)
 
     baseline_path = Path(args.baseline) if args.baseline else root / BASELINE_NAME

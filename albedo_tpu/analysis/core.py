@@ -14,12 +14,18 @@ import ast
 import dataclasses
 import hashlib
 import json
+import os
+import pickle
 import re
+import tempfile
 from functools import lru_cache
 from pathlib import Path
 from typing import Callable, Iterable, Iterator
 
 BASELINE_NAME = ".graftlint-baseline.json"
+# On-disk parse cache: warm `make lint` runs re-parse only changed files.
+CACHE_NAME = ".graftlint-cache.pkl"
+_CACHE_VERSION = 1
 # Fixture snippets are intentionally-violating code: the real sweep must
 # never see them (tests load them as their own little ProjectTrees).
 EXCLUDED_SUBTREES = ("albedo_tpu/analysis/fixtures",)
@@ -100,20 +106,74 @@ class ProjectTree:
         self.root = Path(root)
         self.modules = modules
         self.docs = docs
+        self._callgraph = None
+        self._thread_spawns = None
+        self._lock_inventory = None
+
+    def callgraph(self):
+        """The tree's name-resolution call graph, built once — four of the
+        eight rules need it, and on this tree one build costs more than a
+        whole rule pass."""
+        if self._callgraph is None:
+            from albedo_tpu.analysis.callgraph import CallGraph
+
+            self._callgraph = CallGraph(self)
+        return self._callgraph
+
+    def thread_spawns(self):
+        """Discovered thread/executor spawn sites, computed once per tree."""
+        if self._thread_spawns is None:
+            from albedo_tpu.analysis.callgraph import discover_thread_spawns
+
+            self._thread_spawns = discover_thread_spawns(self, self.callgraph())
+        return self._thread_spawns
+
+    def lock_inventory(self):
+        """The project's mutex inventory, computed once per tree (both R6
+        and R7 need the same full-tree walk)."""
+        if self._lock_inventory is None:
+            from albedo_tpu.analysis.rules_concurrency import lock_inventory
+
+            self._lock_inventory = lock_inventory(self)
+        return self._lock_inventory
 
     @classmethod
-    def load(cls, root: Path, package: str = "albedo_tpu") -> "ProjectTree":
+    def load(
+        cls, root: Path, package: str = "albedo_tpu", cache: bool = False
+    ) -> "ProjectTree":
+        """Parse the tree. ``cache=True`` keys parsed modules by
+        (mtime_ns, size) in ``<root>/.graftlint-cache.pkl`` so a warm run —
+        the 8-rule self-lint over the whole tree — re-parses only changed
+        files. The CLI enables it (``--no-cache`` / ``ALBEDO_LINT_CACHE=0``
+        opt out); library callers (tests on tmp fixture trees) default off
+        so loads never write into fixture directories."""
         root = Path(root)
+        cache_path = root / CACHE_NAME
+        cached: dict[str, tuple[int, int, Module]] = {}
+        if cache:
+            cached = _read_parse_cache(cache_path)
         modules: dict[str, Module] = {}
+        fresh: dict[str, tuple[int, int, Module]] = {}
+        misses = 0
         pkg_dir = root / package
         for py in sorted(pkg_dir.rglob("*.py")):
             rel = py.relative_to(root).as_posix()
             if any(rel == ex or rel.startswith(ex + "/") for ex in EXCLUDED_SUBTREES):
                 continue
-            try:
-                modules[rel] = Module(rel, py.read_text())
-            except SyntaxError as e:
-                raise SyntaxError(f"graftlint cannot parse {rel}: {e}") from e
+            st = py.stat()
+            key = (st.st_mtime_ns, st.st_size)
+            hit = cached.get(rel)
+            if hit is not None and (hit[0], hit[1]) == key:
+                modules[rel] = hit[2]
+            else:
+                try:
+                    modules[rel] = Module(rel, py.read_text())
+                except SyntaxError as e:
+                    raise SyntaxError(f"graftlint cannot parse {rel}: {e}") from e
+                misses += 1
+            fresh[rel] = (key[0], key[1], modules[rel])
+        if cache and (misses or set(fresh) != set(cached)):
+            _write_parse_cache(cache_path, fresh)
         docs = {
             name: (root / name).read_text()
             for name in DOC_FILES
@@ -128,6 +188,46 @@ class ProjectTree:
 
     def get(self, relpath: str) -> Module | None:
         return self.modules.get(relpath)
+
+
+def _read_parse_cache(path: Path) -> dict[str, tuple[int, int, Module]]:
+    """Best-effort: a missing/corrupt/stale-version cache is an empty one.
+    The pickle holds this process's own prior parse output, nothing else."""
+    try:
+        with open(path, "rb") as fh:
+            payload = pickle.load(fh)
+        if payload.get("version") != _CACHE_VERSION:
+            return {}
+        entries = payload.get("entries", {})
+        return {
+            rel: entry for rel, entry in entries.items()
+            if isinstance(entry, tuple) and len(entry) == 3
+            and isinstance(entry[2], Module)
+        }
+    except Exception:
+        return {}
+
+
+def _write_parse_cache(
+    path: Path, entries: dict[str, tuple[int, int, Module]]
+) -> None:
+    """Atomic (tmp + os.replace, the repo's jsonio pattern) so concurrent
+    lint runs never read a torn cache; failures are silently skipped — the
+    cache is an optimization, never a correctness dependency."""
+    tmp = None
+    try:
+        fd, tmp = tempfile.mkstemp(
+            dir=str(path.parent), prefix=path.name, suffix=".tmp"
+        )
+        with os.fdopen(fd, "wb") as fh:
+            pickle.dump({"version": _CACHE_VERSION, "entries": entries}, fh)
+        os.replace(tmp, path)
+    except Exception:
+        if tmp is not None:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
 
 
 def repo_root() -> Path:
@@ -172,8 +272,17 @@ def register(rule_cls: type[Rule]) -> type[Rule]:
 
 
 def all_rules() -> dict[str, Rule]:
-    # Rule modules register on import; importing the package wires them.
-    import albedo_tpu.analysis  # noqa: F401
+    # Rule modules register on import — imported HERE, on first use, not by
+    # the package __init__: sixteen production modules import
+    # analysis.locksmith for named_lock at startup, and that import must
+    # not drag the whole lint tier (rules + callgraph) with it.
+    from albedo_tpu.analysis import (  # noqa: F401
+        rules_concurrency,
+        rules_contract,
+        rules_device,
+        rules_dtype,
+        rules_retrace,
+    )
 
     return dict(_RULES)
 

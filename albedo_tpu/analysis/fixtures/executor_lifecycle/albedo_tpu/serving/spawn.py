@@ -1,0 +1,88 @@
+"""executor-lifecycle fixture: shutdown paths, joins, names, inventory.
+
+The fixture root's ARCHITECTURE.md thread inventory lists `fix-server` and
+`fix-looper` (and a stale `fix-phantom` row). ``serve_ok`` is the
+signal-interruptible foreground-wait shape the real ``serve`` job uses:
+stop event set by SIGTERM/SIGINT, bounded wait, full drain in ``finally``.
+"""
+import signal
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+
+def ok_context_managed(items):
+    with ThreadPoolExecutor(2) as pool:
+        return list(pool.map(str, items))
+
+
+def bad_unbound_pool(items):
+    return ThreadPoolExecutor(2).map(str, items)   # BAD: nobody can shut it down
+
+
+class LeakyPool:
+    def __init__(self):
+        self._pool = ThreadPoolExecutor(2)         # BAD: no .shutdown() anywhere
+
+
+class OwnedPool:
+    def __init__(self):
+        self._pool = ThreadPoolExecutor(2)         # OK: close() shuts it down
+
+    def close(self):
+        self._pool.shutdown(wait=True)
+
+
+class Looper:
+    def __init__(self):
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name="fix-looper", daemon=True
+        )
+        self._thread.start()
+
+    def _run(self):
+        self._stop.wait()
+
+    def stop(self):
+        self._stop.set()
+        self._thread.join(timeout=1.0)             # OK: joined stop path
+
+
+class LeakyLooper:
+    def __init__(self):
+        self._thread = threading.Thread(           # BAD: never joined
+            target=self._run, name="fix-leaky", daemon=True
+        )
+        self._thread.start()
+
+    def _run(self):
+        pass
+
+
+def bad_fire_and_forget():
+    threading.Thread(target=print, name="fix-forgotten").start()  # BAD: non-daemon, unjoinable
+
+
+def serve_ok(server, handle_requests):
+    """The `serve` foreground-wait pattern: a named daemon server thread
+    handed to a joining owner, a signal-interruptible stop event, and a
+    clean shutdown drain in ``finally``."""
+    thread = threading.Thread(
+        target=handle_requests, name="fix-server", daemon=True
+    )
+    thread.start()
+    server.adopt(thread)          # handoff: server.shutdown() joins it
+    stop = threading.Event()
+
+    def _sigstop(_sig, _frame):
+        stop.set()
+        for s in (signal.SIGTERM, signal.SIGINT):
+            signal.signal(s, signal.SIG_DFL)   # second signal force-kills
+
+    for _sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(_sig, _sigstop)
+    try:
+        stop.wait()
+    finally:
+        server.shutdown()
+        thread.join(timeout=1.0)

@@ -1,0 +1,56 @@
+"""lock-discipline fixture: with-only acquisition, catalogued nesting,
+locksmith-visible creation.
+
+The fixture root's ARCHITECTURE.md declares the lock-order catalog
+`fix.outer` -> `fix.inner` (plus a stale row naming `fix.ghost`).
+"""
+import threading
+
+from albedo_tpu.analysis.locksmith import named_lock
+
+
+class Locky:
+    def __init__(self):
+        self._outer = named_lock("fix.outer")
+        self._inner = named_lock("fix.inner")
+        self._stray = named_lock("fix.stray")
+        self._bare = threading.Lock()    # BAD: invisible to the sanitizer
+
+    def ok_declared_order(self):
+        with self._outer:
+            with self._inner:            # OK: catalogued direction
+                return 1
+
+    def bad_inverted_order(self):
+        with self._inner:
+            with self._outer:            # BAD: inverts the catalogued pair
+                return 2
+
+    def bad_uncatalogued_pair(self):
+        with self._outer:
+            with self._stray:            # BAD: pair not in the catalog
+                return 3
+
+    def ok_call_through(self):
+        with self._outer:
+            return self._inner_locked()  # OK via catalog: outer -> inner
+
+    def _inner_locked(self):
+        with self._inner:
+            return 4
+
+    def bad_manual_acquire(self):
+        self._outer.acquire()            # BAD: mutex outside `with`
+        try:
+            return 5
+        finally:
+            self._outer.release()        # BAD: ditto
+
+    def ok_joined_non_daemon(self):
+        # OK: bound and joined — the daemon obligation is R8's, and it is
+        # conditioned on the spawn lacking a join path; R7 must not demand
+        # daemon=True from a correctly joined worker.
+        t = threading.Thread(target=self.ok_declared_order, name="fix-nd")
+        t.start()
+        t.join()
+        return t

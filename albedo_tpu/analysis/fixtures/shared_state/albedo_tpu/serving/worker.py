@@ -1,0 +1,99 @@
+"""shared-state-guard fixture: cross-thread writes, guarded and not.
+
+``Worker`` spawns a thread onto ``self._run``; attributes written in the
+worker closure and touched from the public (main) methods must hold a
+common lock, be a primitive, or carry a pragma. ``_COUNT`` exercises the
+module-global arm (this module spawns, so unguarded global rebinds fire).
+"""
+import queue
+import threading
+
+_G_LOCK = threading.Lock()
+_COUNT = 0
+_TOTAL = 0
+
+
+def bump_unguarded():
+    global _COUNT
+    _COUNT = _COUNT + 1        # BAD: unguarded global rebind, module spawns
+
+
+def bump_guarded():
+    global _TOTAL
+    with _G_LOCK:
+        _TOTAL = _TOTAL + 1    # OK: every write guarded by the module lock
+
+
+class Worker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._q = queue.Queue()          # primitive: self-guarded
+        self._stop = threading.Event()   # primitive: self-guarded
+        self.config = {"k": 30}          # written only here: publish-once
+        self.processed = 0               # worker-written, main-read
+        self.latency = 0.0
+        self.debug_marks = 0
+        self._results = {}
+        self._thread = threading.Thread(
+            target=self._run, name="fix-worker", daemon=True
+        )
+        self._thread.start()
+
+    # ------------------------------------------------------------- worker
+    def _run(self):
+        while not self._stop.is_set():
+            item = self._q.get()
+            self.processed += 1          # BAD: unguarded, read from stats()
+            # Single consumer thread owns this mark; readers tolerate
+            # staleness by design.
+            self.debug_marks += 1        # albedo: noqa[shared-state-guard]
+            with self._lock:
+                self._results[item] = item  # OK: guarded write...
+            self._observe(0.1)
+
+    def _observe(self, seconds):
+        with self._lock:
+            self.latency = seconds       # OK: every write guarded (here...)
+
+    # --------------------------------------------------------------- main
+    def stats(self):
+        return {"processed": self.processed, "latency": self.latency}
+
+    def result(self, key):
+        with self._lock:
+            return self._results.get(key)
+
+    def record(self, seconds):
+        with self._lock:
+            self._set_latency_locked(seconds)
+
+    def _set_latency_locked(self, seconds):
+        # OK: only ever called with self._lock held (caller-intersection
+        # fixpoint proves it) — the *_locked helper pattern.
+        self.latency = seconds
+
+    def stop(self):
+        self._stop.set()
+        self._thread.join(timeout=1.0)
+
+
+class Restarter:
+    """The locked-caller laundering shape: ``restart()`` calls the thread
+    target under a lock, but the spawned thread enters ``_run`` holding
+    nothing — the unguarded write must STILL fire (entry methods are
+    pinned empty in the caller-intersection fixpoint)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.ticks = 0
+        threading.Thread(target=self._run, name="fix-restart", daemon=True).start()
+
+    def _run(self):
+        self.ticks += 1                  # BAD: bare thread entry, lock-free
+
+    def restart(self):
+        with self._lock:
+            self._run()
+
+    def read(self):
+        return self.ticks

@@ -1,0 +1,320 @@
+"""locksmith — the runtime lock-order sanitizer behind ``ALBEDO_LOCKCHECK=1``.
+
+The static concurrency rules (R6-R8) see lexical structure; they cannot see
+the *dynamic* acquisition order a swap-under-load or a chaos cycle actually
+produces. locksmith closes that gap: every production mutex is created
+through :func:`named_lock`, which returns a plain ``threading.Lock`` in
+normal operation (zero overhead, zero import weight) and a tracked wrapper
+when ``ALBEDO_LOCKCHECK=1`` is set at creation time. Tracked locks:
+
+- maintain a per-thread stack of held locks;
+- record every (held -> acquiring) edge in a process-global lock-order
+  graph, per lock *instance* (two instances sharing a name are distinct
+  nodes, so sibling objects cannot fake a cycle);
+- detect **order inversions**: acquiring B while holding A after some
+  thread acquired A while holding B is the classic ABBA deadlock shape —
+  recorded as a violation (kind ``order``) and counted in
+  ``albedo_lockcheck_violations_total{kind=}``;
+- detect **self-deadlock**: re-acquiring a non-reentrant tracked lock the
+  current thread already holds raises :class:`LockOrderViolation`
+  immediately (the untracked alternative is hanging forever).
+
+For R6-registered shared state, :func:`note_access` implements the
+unguarded-concurrent-access check: each access records (thread, held
+tracked locks); once two threads have touched the object with at least one
+write and **no lock in common across every access**, a violation (kind
+``unguarded``) is recorded.
+
+The chaos soak checks :func:`violations` as a standing invariant each
+cycle, and ``make sanitize`` re-runs the batcher/reload/breaker/elastic
+thread suites plus a short soak leg with the sanitizer armed — that run is
+what validates the ARCHITECTURE.md lock-order catalog against observed
+behavior (:func:`order_edges` exposes the observed pairs by catalog name).
+
+This module is stdlib-only and import-light on purpose: production modules
+import it for ``named_lock`` at module-import time, so it must never pull
+jax (or anything heavy) in.
+"""
+
+from __future__ import annotations
+
+import itertools
+import logging
+import os
+import threading
+
+log = logging.getLogger(__name__)
+
+_ENV = "ALBEDO_LOCKCHECK"
+
+LOCKCHECK_KIND_ORDER = "order"
+LOCKCHECK_KIND_SELF = "self-deadlock"
+LOCKCHECK_KIND_UNGUARDED = "unguarded"
+
+
+def enabled() -> bool:
+    """Is the sanitizer armed? Read at lock-creation time: modules create
+    their locks at import/instance construction, so the env var must be set
+    before the code under test is imported (``make sanitize`` does)."""
+    return os.environ.get(_ENV, "0").lower() not in ("", "0", "false", "off")
+
+
+class LockOrderViolation(RuntimeError):
+    """Raised on certain-deadlock shapes (re-acquiring a held non-reentrant
+    lock); potential-deadlock shapes (order inversions) are recorded in
+    :func:`violations` instead, so a soak can finish its cycle and report."""
+
+
+class _State:
+    """Process-global sanitizer state. Internal synchronization uses a raw
+    ``threading.Lock`` — the sanitizer must not track itself."""
+
+    def __init__(self) -> None:
+        self.guard = threading.Lock()
+        self.ids = itertools.count(1)
+        # Monotonic violation sequence — deliberately NOT cleared by
+        # reset(), so cursor-style consumers (the soak invariant sweep)
+        # can tell a fresh epoch's violations from ones already reported.
+        self.seq = itertools.count(1)
+        self.names: dict[int, str] = {}            # instance id -> name
+        self.edges: dict[int, set[int]] = {}       # instance-order graph
+        self.edge_names: set[tuple[str, str]] = set()
+        self.violations: list[dict] = []
+        self.tls = threading.local()
+        self.shared: dict[object, dict] = {}       # name|(name, owner id) -> record
+
+    def held_stack(self) -> list["_TrackedLock"]:
+        stack = getattr(self.tls, "stack", None)
+        if stack is None:
+            stack = self.tls.stack = []
+        return stack
+
+    def path_exists(self, src: int, dst: int) -> list[int] | None:
+        """DFS path src -> dst in the instance edge graph (caller holds
+        ``guard``); returns the witnessing node path or None."""
+        seen = {src}
+        frontier = [(src, [src])]
+        while frontier:
+            node, path = frontier.pop()
+            for nxt in self.edges.get(node, ()):
+                if nxt == dst:
+                    return path + [nxt]
+                if nxt not in seen:
+                    seen.add(nxt)
+                    frontier.append((nxt, path + [nxt]))
+        return None
+
+
+
+_STATE = _State()
+
+
+def _emit_violation(kind: str, message: str, **detail) -> None:
+    """Record + count a violation. MUST be called WITHOUT ``_STATE.guard``
+    held: the lazy events import below can execute module bodies that
+    construct tracked locks (utils/__init__ -> faults' registry), and
+    ``_TrackedLock.__init__`` takes the guard — importing under it is a
+    self-deadlock (found by the verify drive, not a hypothetical)."""
+    entry = {"kind": kind, "message": message, **detail}
+    with _STATE.guard:
+        entry["seq"] = next(_STATE.seq)
+        _STATE.violations.append(entry)
+    log.warning("locksmith: %s violation: %s", kind, message)
+    try:
+        # Lazy: events lives in a package whose __init__ pulls jax; the
+        # lint legs must never import it. The counter itself is defined
+        # once, in events — importing the module constructs it.
+        from albedo_tpu.utils import events
+
+        events.lockcheck_violations.inc(kind=kind)
+    except Exception:  # pragma: no cover — metrics must never mask a report
+        pass
+
+
+class _TrackedLock:
+    """A mutex wrapper that feeds the order graph. API-compatible with the
+    ``threading.Lock`` surface the codebase uses (``with``, ``acquire`` /
+    ``release`` with timeouts, ``locked``)."""
+
+    def __init__(self, name: str, reentrant: bool = False):
+        self.name = name
+        self.reentrant = reentrant
+        self._lock = threading.RLock() if reentrant else threading.Lock()
+        self._id = next(_STATE.ids)
+        with _STATE.guard:
+            _STATE.names[self._id] = name
+
+    # ------------------------------------------------------------ tracking
+    def _check_self_deadlock(self) -> "_TrackedLock | None":
+        """Pre-acquire: raise on a certain deadlock, and return the lock
+        this thread currently holds on top (the edge source) — the edge
+        itself is recorded only once the acquire SUCCEEDS, so a failed
+        non-blocking/timeout attempt cannot plant a phantom ordering."""
+        stack = _STATE.held_stack()
+        if not stack:
+            return None
+        if any(l is self for l in stack):
+            if self.reentrant:
+                return None
+            msg = (
+                f"re-acquiring non-reentrant lock `{self.name}` already "
+                f"held by this thread — certain deadlock"
+            )
+            _emit_violation(LOCKCHECK_KIND_SELF, msg, lock=self.name)
+            raise LockOrderViolation(msg)
+        return stack[-1]
+
+    def _record_edge(self, top: "_TrackedLock") -> None:
+        back = None
+        with _STATE.guard:
+            fwd = _STATE.edges.setdefault(top._id, set())
+            if self._id in fwd:
+                return
+            # New edge: does the reverse order already exist anywhere?
+            back = _STATE.path_exists(self._id, top._id)
+            fwd.add(self._id)
+            _STATE.edge_names.add((top.name, self.name))
+            cycle = (
+                " -> ".join(_STATE.names.get(i, "?") for i in back)
+                if back is not None else ""
+            )
+        if back is not None:
+            _emit_violation(
+                LOCKCHECK_KIND_ORDER,
+                f"lock-order inversion: acquiring `{self.name}` "
+                f"while holding `{top.name}`, but the opposite "
+                f"order `{cycle}` was already observed — ABBA "
+                f"deadlock shape",
+                acquiring=self.name, holding=top.name,
+            )
+
+    # ------------------------------------------------------------- lock API
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        top = self._check_self_deadlock()
+        got = self._lock.acquire(blocking, timeout)
+        if got:
+            if top is not None:
+                self._record_edge(top)
+            _STATE.held_stack().append(self)
+        return got
+
+    def release(self) -> None:
+        stack = _STATE.held_stack()
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i] is self:
+                del stack[i]
+                break
+        self._lock.release()
+
+    def locked(self) -> bool:
+        # Mirror the wrapped primitive exactly: threading.RLock only grew
+        # .locked() in Python 3.12, and the tracked wrapper must surface
+        # the same AttributeError the untracked lock would.
+        inner = getattr(self._lock, "locked", None)
+        if inner is None:
+            raise AttributeError(
+                f"{type(self._lock).__name__} has no locked() on this Python"
+            )
+        return inner()
+
+    def __enter__(self) -> "_TrackedLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.release()
+        return False
+
+    def __repr__(self) -> str:  # pragma: no cover — debugging aid
+        return f"<_TrackedLock {self.name!r}>"
+
+
+def named_lock(name: str, reentrant: bool = False):
+    """The one way production code creates a mutex. Plain
+    ``threading.Lock()`` (or ``RLock``) when the sanitizer is off — zero
+    overhead, indistinguishable from before — and a :class:`_TrackedLock`
+    under ``ALBEDO_LOCKCHECK=1``. ``name`` is the lock's id in the
+    ARCHITECTURE.md lock-order catalog; graftlint R7 enforces that bare
+    ``threading.Lock()`` does not reappear in the instrumented packages."""
+    if not enabled():
+        return threading.RLock() if reentrant else threading.Lock()
+    return _TrackedLock(name, reentrant=reentrant)
+
+
+# --- R6-registered shared-state monitoring ------------------------------------
+
+
+def note_access(name: str, write: bool = False, owner: object | None = None) -> None:
+    """Record an access to a shared object registered under ``name``.
+
+    Per accessing thread locksmith keeps the *intersection* of tracked
+    locks held across all of that thread's accesses. Once >= 2 threads have
+    accessed with at least one write and the global intersection is empty,
+    there is provably no common lock protecting the object — a violation of
+    kind ``unguarded``, recorded once per record. No-op when disabled.
+
+    ``owner`` scopes the record to one instance — pass ``self`` for
+    per-instance state guarded by per-instance locks: two instances (a
+    live batcher and a reload candidate's) each writing under their OWN
+    lock instance share no lock by construction and must not read as a
+    violation. Records are keyed by the owner *object* (held strongly
+    until :func:`reset`, so a recycled ``id()`` cannot merge two owners),
+    and threads by the ``Thread`` object, not ``get_ident()`` — CPython
+    reuses idents after a thread exits, which would fold a dead worker's
+    lockset into an unrelated new one."""
+    if not enabled():
+        return
+    held = frozenset(l._id for l in _STATE.held_stack())
+    thread = threading.current_thread()
+    key = name if owner is None else (name, id(owner))
+    report = None
+    with _STATE.guard:
+        rec = _STATE.shared.setdefault(
+            key,
+            {"threads": {}, "write": False, "reported": False, "owner": owner},
+        )
+        rec["write"] = rec["write"] or bool(write)
+        prev = rec["threads"].get(thread)
+        rec["threads"][thread] = held if prev is None else (prev & held)
+        if rec["reported"] or not rec["write"] or len(rec["threads"]) < 2:
+            return
+        common = None
+        for lockset in rec["threads"].values():
+            common = lockset if common is None else (common & lockset)
+        if not common:
+            rec["reported"] = True
+            report = len(rec["threads"])
+    if report is not None:
+        _emit_violation(
+            LOCKCHECK_KIND_UNGUARDED,
+            f"shared object `{name}` written concurrently from "
+            f"{report} threads with no common lock held",
+            shared=name,
+        )
+
+
+# --- reporting ----------------------------------------------------------------
+
+
+def violations() -> list[dict]:
+    """Every violation recorded since the last :func:`reset` (soak checks
+    this is empty as a standing invariant)."""
+    with _STATE.guard:
+        return list(_STATE.violations)
+
+
+def order_edges() -> set[tuple[str, str]]:
+    """Observed (outer, inner) acquisition pairs by catalog name — what
+    ``make sanitize`` compares against the ARCHITECTURE.md catalog."""
+    with _STATE.guard:
+        return set(_STATE.edge_names)
+
+
+def reset() -> None:
+    """Drop the order graph, shared-state records, and violations (test
+    isolation). Existing tracked locks stay valid; their edges re-record."""
+    with _STATE.guard:
+        _STATE.edges.clear()
+        _STATE.edge_names.clear()
+        _STATE.violations.clear()
+        _STATE.shared.clear()

@@ -1,0 +1,808 @@
+"""R6-R8 — the concurrency tier: shared state, lock discipline, lifecycles.
+
+The last four PRs quietly made this a heavily threaded system: the pipelined
+sharded fit runs a daemon prefetch uploader, elastic fits wrap chunk
+dispatches in deadline workers, and serving stacks a micro-batcher, reload
+watcher, breaker pools, and an HTTP server on ~20 locks. Races and
+lock-order inversions are the dominant un-tooled bug class (PR 12's review
+rounds caught a non-daemon wedged-dispatch hang and a /metrics-scrape race
+by eyeball). These rules sit on the call graph's thread-root discovery
+(:mod:`albedo_tpu.analysis.callgraph`) and make the discipline static:
+
+- **R6 ``shared-state-guard``**: a module global or instance attribute
+  written inside one thread context and touched from another must be
+  guarded by a common lock, be a synchronization primitive
+  (``queue.Queue``/``Event``/...), or carry a reasoned pragma. Contexts are
+  derived per class: the closure of the class's spawned thread targets vs
+  the closure of its other methods (``__init__`` is pre-publication and
+  exempt). Lock possession is tracked lexically (``with self._lock:``) plus
+  a caller-intersection fixpoint, so ``*_locked`` helpers called only under
+  the lock count as guarded.
+- **R7 ``lock-discipline``**: mutex acquisition only via ``with`` (bare
+  ``.acquire()``/``.release()`` on an inventoried lock is a finding); locks
+  in the instrumented packages must be created through
+  ``analysis.locksmith.named_lock`` so the runtime sanitizer can wrap them;
+  nested acquisition (lexical, or one call-hop deep) requires the ordered
+  pair to appear in the ARCHITECTURE.md lock-order catalog — enforced both
+  directions like the fault-site catalog (a catalogued pair must also name
+  locks that still exist).
+- **R8 ``executor-lifecycle``**: every ``ThreadPoolExecutor`` is
+  context-managed or has a reachable ``.shutdown()``; every bound
+  ``threading.Thread`` has a reachable ``.join()`` (or an explicit handoff);
+  fire-and-forget threads must be daemon (the PR 12 wedged-exit class —
+  the daemon obligation lives HERE, conditioned on the spawn lacking a
+  join path, so a correctly joined non-daemon worker is not flagged);
+  every thread spawn carries a ``name=`` and appears in the
+  ARCHITECTURE.md thread-inventory table, both directions.
+
+The runtime complement is :mod:`albedo_tpu.analysis.locksmith`
+(``ALBEDO_LOCKCHECK=1``), which validates the static catalog against
+observed acquisition order inside the chaos soak and the threaded suites.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from typing import Iterator
+
+from albedo_tpu.analysis.callgraph import CallGraph, ThreadSpawn
+from albedo_tpu.analysis.core import (
+    Finding,
+    Module,
+    ProjectTree,
+    Rule,
+    dotted_name,
+    last_segment,
+    register,
+    walk_with_stack,
+)
+
+# Packages whose locks must be created through locksmith.named_lock so the
+# runtime sanitizer can observe them (the threaded production surface).
+LOCKSMITH_PACKAGES = (
+    "albedo_tpu/serving/",
+    "albedo_tpu/retrieval/",
+    "albedo_tpu/parallel/",
+    "albedo_tpu/streaming/",
+    "albedo_tpu/store/",
+    "albedo_tpu/utils/",
+)
+
+_MUTEX_CTORS = {"threading.Lock", "threading.RLock"}
+# Attribute values that are self-guarded concurrency primitives: writes to
+# them cross threads by design and synchronize internally.
+_PRIMITIVE_CTORS = {
+    "Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore", "Event",
+    "Barrier", "Queue", "SimpleQueue", "LifoQueue", "PriorityQueue",
+    "Future", "local", "named_lock",
+}
+
+
+# --- lock inventory -----------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class LockInfo:
+    module: str
+    cls: str | None            # owning class, None for module-level locks
+    attr: str                  # attribute / global name
+    line: int
+    name: str                  # catalog id (named_lock literal, or derived)
+    via_named_lock: bool
+
+
+def _derived_lock_name(module: str, cls: str | None, attr: str) -> str:
+    stem = module.removeprefix("albedo_tpu/").removesuffix(".py").replace("/", ".")
+    return f"{stem}.{cls}.{attr}" if cls else f"{stem}.{attr}"
+
+
+def lock_inventory(tree: ProjectTree) -> dict[tuple[str, str | None, str], LockInfo]:
+    """Every mutex binding in the project: ``self.attr = threading.Lock()``
+    (keyed by owning class) or a module-level ``NAME = threading.Lock()``,
+    plus the same shapes through ``locksmith.named_lock("id")`` — whose
+    literal id becomes the lock's catalog name."""
+    inv: dict[tuple[str, str | None, str], LockInfo] = {}
+    for rel, mod in tree.modules.items():
+
+        def visit(node: ast.AST, stack: tuple[ast.AST, ...]) -> None:
+            if not (isinstance(node, ast.Assign) and len(node.targets) == 1):
+                return
+            value = node.value
+            if not isinstance(value, ast.Call):
+                return
+            dn = dotted_name(value.func)
+            named = last_segment(value.func) == "named_lock"
+            if not named and dn not in _MUTEX_CTORS:
+                return
+            tgt = node.targets[0]
+            cls = next(
+                (a.name for a in stack if isinstance(a, ast.ClassDef)), None
+            )
+            if isinstance(tgt, ast.Attribute) and isinstance(tgt.value, ast.Name) \
+                    and tgt.value.id == "self" and cls:
+                key = (rel, cls, tgt.attr)
+                attr = tgt.attr
+            elif isinstance(tgt, ast.Name) and cls is None and not any(
+                isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef))
+                for a in stack
+            ):
+                key = (rel, None, tgt.id)
+                attr = tgt.id
+            else:
+                return
+            name = _derived_lock_name(rel, key[1], attr)
+            if named and value.args and isinstance(value.args[0], ast.Constant) \
+                    and isinstance(value.args[0].value, str):
+                name = value.args[0].value
+            inv[key] = LockInfo(rel, key[1], attr, node.lineno, name, named)
+
+        walk_with_stack(mod.tree, visit)
+    return inv
+
+
+def _lock_at(
+    inv: dict, rel: str, cls: str | None, expr: ast.AST
+) -> LockInfo | None:
+    """The inventoried lock a ``with``-item / call receiver denotes, if any."""
+    if isinstance(expr, ast.Attribute) and isinstance(expr.value, ast.Name) \
+            and expr.value.id == "self" and cls is not None:
+        return inv.get((rel, cls, expr.attr))
+    if isinstance(expr, ast.Name):
+        return inv.get((rel, None, expr.id))
+    return None
+
+
+# --- ARCHITECTURE.md tables ---------------------------------------------------
+
+_PAIR = re.compile(r"`([a-z0-9_.-]+)`\s*(?:->|→)\s*`([a-z0-9_.-]+)`")
+_THREAD_NAME_CELL = re.compile(r"`([a-z][a-z0-9-]*-[a-z0-9-]+)`")
+
+
+def _section_lines(text: str, heading_re: str) -> list[tuple[int, str]]:
+    """(lineno, line) pairs of the markdown section whose heading matches
+    ``heading_re`` (case-insensitive), up to the next heading."""
+    pat = re.compile(heading_re, re.IGNORECASE)
+    out: list[tuple[int, str]] = []
+    in_section = False
+    for i, line in enumerate(text.splitlines(), start=1):
+        if line.startswith("#"):
+            if in_section:
+                break
+            in_section = bool(pat.search(line))
+            continue
+        if in_section:
+            out.append((i, line))
+    return out
+
+
+def lock_order_catalog(tree: ProjectTree) -> dict[tuple[str, str], int]:
+    """Declared lock-order pairs (```a` -> `b``` in the first cell of
+    the catalog table rows) -> line number."""
+    text = tree.docs.get("ARCHITECTURE.md", "")
+    pairs: dict[tuple[str, str], int] = {}
+    for lineno, line in _section_lines(text, r"lock-order catalog"):
+        if not line.startswith("|"):
+            continue
+        m = _PAIR.search(line.split("|")[1])
+        if m:
+            pairs[(m.group(1), m.group(2))] = lineno
+    return pairs
+
+
+def thread_inventory_doc(tree: ProjectTree) -> dict[str, int]:
+    """Thread names catalogued in the ARCHITECTURE.md thread-inventory
+    table (first cell, backticked) -> line number."""
+    text = tree.docs.get("ARCHITECTURE.md", "")
+    names: dict[str, int] = {}
+    for lineno, line in _section_lines(text, r"thread inventory"):
+        if not line.startswith("|"):
+            continue
+        m = _THREAD_NAME_CELL.search(line.split("|")[1])
+        if m:
+            names[m.group(1)] = lineno
+    return names
+
+
+# --- shared helpers over a class's methods ------------------------------------
+
+
+def _class_methods(
+    graph: CallGraph, rel: str, cls: str
+) -> dict[str, ast.AST]:
+    prefix = f"{cls}."
+    return {
+        qual[len(prefix):]: info.node
+        for (mod, qual), info in graph.functions.items()
+        if mod == rel and qual.startswith(prefix)
+    }
+
+
+def _intra_class_edges(methods: dict[str, ast.AST]) -> dict[str, set[str]]:
+    edges: dict[str, set[str]] = {m: set() for m in methods}
+    for m, node in methods.items():
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call) and isinstance(sub.func, ast.Attribute):
+                base = sub.func.value
+                if isinstance(base, ast.Name) and base.id == "self" \
+                        and sub.func.attr in methods:
+                    edges[m].add(sub.func.attr)
+    return edges
+
+
+def _closure(edges: dict[str, set[str]], roots: set[str]) -> set[str]:
+    seen = set(r for r in roots if r in edges)
+    frontier = list(seen)
+    while frontier:
+        m = frontier.pop()
+        for n in edges.get(m, ()):
+            if n not in seen:
+                seen.add(n)
+                frontier.append(n)
+    return seen
+
+
+def _lexical_locks(
+    inv: dict, rel: str, cls: str | None, stack: tuple[ast.AST, ...]
+) -> frozenset[str]:
+    """Lock names held lexically at a node, from enclosing With items."""
+    held: set[str] = set()
+    for anc in stack:
+        if isinstance(anc, (ast.With, ast.AsyncWith)):
+            for item in anc.items:
+                lock = _lock_at(inv, rel, cls, item.context_expr)
+                if lock is not None:
+                    held.add(lock.name)
+    return frozenset(held)
+
+
+def _held_at_entry(
+    inv: dict, rel: str, cls: str,
+    methods: dict[str, ast.AST],
+    entry: frozenset[str] = frozenset(),
+) -> dict[str, frozenset[str]]:
+    """For each method, the locks provably held on EVERY intra-class call
+    path into it — the ``_check_error_rate_locked`` pattern, where the
+    caller takes the lock and the helper does the writing. Meet is
+    intersection over call sites; methods with no intra-class callers
+    (public entry points, thread targets) start at the empty set.
+    ``entry`` methods are pinned empty regardless of intra-class callers:
+    a spawn target is ALSO entered directly by its thread holding nothing,
+    so a locked helper calling it must not launder the bare entry away."""
+    universe = frozenset(l.name for l in inv.values())
+    call_sites: dict[str, list[tuple[str, frozenset[str]]]] = {m: [] for m in methods}
+    for m, node in methods.items():
+
+        def visit(sub: ast.AST, stack: tuple[ast.AST, ...], _m=m) -> None:
+            if isinstance(sub, ast.Call) and isinstance(sub.func, ast.Attribute):
+                base = sub.func.value
+                if isinstance(base, ast.Name) and base.id == "self" \
+                        and sub.func.attr in methods:
+                    call_sites[sub.func.attr].append(
+                        (_m, _lexical_locks(inv, rel, cls, stack))
+                    )
+
+        walk_with_stack(node, visit)
+
+    held = {
+        m: (universe if call_sites[m] and m not in entry else frozenset())
+        for m in methods
+    }
+    for _ in range(len(methods) + 1):
+        changed = False
+        for m in methods:
+            if not call_sites[m] or m in entry:
+                continue
+            new: frozenset[str] | None = None
+            for caller, lex in call_sites[m]:
+                path = lex | held.get(caller, frozenset())
+                new = path if new is None else (new & path)
+            new = new if new is not None else frozenset()
+            if new != held[m]:
+                held[m] = new
+                changed = True
+        if not changed:
+            break
+    return held
+
+
+def _attr_store_names(tgt: ast.AST) -> Iterator[str]:
+    """self-attribute names stored by an assignment target (handles tuple
+    unpacking and subscript stores like ``self._stats[k] = v``)."""
+    if isinstance(tgt, (ast.Tuple, ast.List)):
+        for elt in tgt.elts:
+            yield from _attr_store_names(elt)
+        return
+    if isinstance(tgt, ast.Subscript):
+        tgt = tgt.value
+    if isinstance(tgt, ast.Attribute) and isinstance(tgt.value, ast.Name) \
+            and tgt.value.id == "self":
+        yield tgt.attr
+
+
+# --- R6 -----------------------------------------------------------------------
+
+
+@register
+class SharedStateGuard(Rule):
+    id = "shared-state-guard"
+    summary = (
+        "cross-thread instance attributes / module globals written without "
+        "a common lock, a synchronization primitive, or a reasoned pragma"
+    )
+
+    def check(self, tree: ProjectTree) -> Iterator[Finding]:
+        graph = tree.callgraph()
+        spawns = tree.thread_spawns()
+        inv = tree.lock_inventory()
+
+        by_class: dict[tuple[str, str], set[str]] = {}
+        for sp in spawns:
+            if sp.target is None or sp.encl_class is None:
+                continue
+            t_mod, t_qual = sp.target
+            if t_mod == sp.module and t_qual.startswith(sp.encl_class + "."):
+                by_class.setdefault((sp.module, sp.encl_class), set()).add(
+                    t_qual.split(".", 1)[1]
+                )
+
+        for (rel, cls), targets in sorted(by_class.items()):
+            yield from self._check_class(tree, graph, inv, rel, cls, targets)
+        yield from self._check_globals(tree, graph, spawns, inv)
+
+    # -------------------------------------------------------------- classes
+    def _check_class(
+        self, tree: ProjectTree, graph: CallGraph, inv: dict,
+        rel: str, cls: str, targets: set[str],
+    ) -> Iterator[Finding]:
+        mod = tree.get(rel)
+        assert mod is not None
+        methods = _class_methods(graph, rel, cls)
+        edges = _intra_class_edges(methods)
+        thread_ctx = _closure(edges, targets)
+        main_roots = {
+            m for m in methods if m not in targets and m != "__init__"
+        }
+        main_ctx = _closure(edges, main_roots)
+        held = _held_at_entry(inv, rel, cls, methods, entry=frozenset(targets))
+
+        # Attributes assigned a concurrency primitive anywhere in the class
+        # synchronize themselves; lock attributes are the guards, not state.
+        primitives: set[str] = set()
+        for m, node in methods.items():
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Assign) and isinstance(sub.value, ast.Call):
+                    ctor = last_segment(sub.value.func)
+                    if ctor in _PRIMITIVE_CTORS:
+                        for tgt in sub.targets:
+                            primitives.update(_attr_store_names(tgt))
+
+        # writes[attr] = [(method, node, guard lockset)]
+        writes: dict[str, list[tuple[str, ast.AST, frozenset[str]]]] = {}
+        touched: dict[str, set[str]] = {}
+        for m, node in methods.items():
+            ctxs = set()
+            if m in thread_ctx:
+                ctxs.add("thread")
+            if m in main_ctx:
+                ctxs.add("main")
+
+            def visit(sub: ast.AST, stack: tuple[ast.AST, ...], _m=m, _ctxs=ctxs) -> None:
+                if isinstance(sub, ast.Attribute) and isinstance(sub.value, ast.Name) \
+                        and sub.value.id == "self":
+                    touched.setdefault(sub.attr, set()).update(_ctxs)
+                if _m == "__init__":
+                    return  # pre-publication: no other thread exists yet
+                stores: list[str] = []
+                if isinstance(sub, ast.Assign):
+                    for tgt in sub.targets:
+                        stores.extend(_attr_store_names(tgt))
+                elif isinstance(sub, (ast.AugAssign, ast.AnnAssign)):
+                    stores.extend(_attr_store_names(sub.target))
+                if stores:
+                    guard = _lexical_locks(inv, rel, cls, stack) | held.get(
+                        _m, frozenset()
+                    )
+                    for attr in stores:
+                        writes.setdefault(attr, []).append((_m, sub, guard))
+
+            walk_with_stack(node, visit)
+
+        for attr in sorted(writes):
+            if attr in primitives or (rel, cls, attr) in inv:
+                continue
+            w_ctxs = set()
+            for m, _node, _g in writes[attr]:
+                if m in thread_ctx:
+                    w_ctxs.add("thread")
+                if m in main_ctx:
+                    w_ctxs.add("main")
+            t_ctxs = touched.get(attr, set())
+            cross = ("thread" in w_ctxs and "main" in t_ctxs) or (
+                "main" in w_ctxs and "thread" in t_ctxs
+            )
+            if not cross:
+                continue
+            common = None
+            for _m, _node, guard in writes[attr]:
+                common = guard if common is None else (common & guard)
+            if common:
+                continue  # every write holds a common lock
+            # One finding PER write site: pragmas suppress by line, so a
+            # single aggregate anchor would let sibling unguarded writes
+            # hide under one pragma (and re-anchor when sites reorder).
+            for m, node, _g in writes[attr]:
+                yield Finding(
+                    self.id, rel, node.lineno, node.col_offset,
+                    f"`self.{attr}` is written in `{cls}.{m}` and touched "
+                    f"from another thread context of `{cls}` (thread "
+                    f"targets: {', '.join(sorted(targets))}) with no lock "
+                    f"common to all writes — guard every write with one "
+                    f"lock, publish through a queue/Event/immutable "
+                    f"snapshot, or pragma with the reason",
+                    mod.line_text(node.lineno),
+                )
+
+    # -------------------------------------------------------------- globals
+    def _check_globals(
+        self, tree: ProjectTree, graph: CallGraph,
+        spawns: list[ThreadSpawn], inv: dict,
+    ) -> Iterator[Finding]:
+        spawning_modules = {sp.module for sp in spawns}
+        for rel in sorted(spawning_modules):
+            mod = tree.get(rel)
+            if mod is None:
+                continue
+            # Any unlocked `global` rebinding in a module that spawns
+            # threads is flagged — deliberately coarser than the per-class
+            # analysis (a rebound global is reachable from every thread the
+            # module starts, so "touched from another context" is assumed).
+            writers: dict[str, list[tuple[str, ast.AST, frozenset[str]]]] = {}
+            for (m_rel, qual), info in graph.functions.items():
+                if m_rel != rel:
+                    continue
+                declared = {
+                    n for sub in ast.walk(info.node)
+                    if isinstance(sub, ast.Global) for n in sub.names
+                }
+                if not declared:
+                    continue
+
+                def visit(sub: ast.AST, stack: tuple[ast.AST, ...],
+                          _qual=qual, _declared=declared) -> None:
+                    if isinstance(sub, ast.Name) and sub.id in _declared \
+                            and isinstance(sub.ctx, ast.Store):
+                        writers.setdefault(sub.id, []).append((
+                            _qual, sub,
+                            _lexical_locks(inv, rel, None, stack),
+                        ))
+
+                walk_with_stack(info.node, visit)
+            for name, sites in sorted(writers.items()):
+                common = None
+                for _q, _node, guard in sites:
+                    common = guard if common is None else (common & guard)
+                if common:
+                    continue
+                for qual, node, _g in sites:  # per site, like the class arm
+                    yield Finding(
+                        self.id, rel, node.lineno, node.col_offset,
+                        f"module global `{name}` is rebound in `{qual}` while "
+                        f"this module spawns threads — guard the write with a "
+                        f"module lock or pragma with the reason",
+                        mod.line_text(node.lineno),
+                    )
+
+
+# --- R7 -----------------------------------------------------------------------
+
+
+@register
+class LockDiscipline(Rule):
+    id = "lock-discipline"
+    summary = (
+        "with-only mutex acquisition, locksmith-visible lock creation, "
+        "catalogued nested lock order"
+    )
+
+    def check(self, tree: ProjectTree) -> Iterator[Finding]:
+        inv = tree.lock_inventory()
+        graph = tree.callgraph()
+        yield from self._check_creation(tree, inv)
+        yield from self._check_acquire(tree, inv)
+        yield from self._check_nesting(tree, graph, inv)
+
+    def _check_creation(self, tree: ProjectTree, inv: dict) -> Iterator[Finding]:
+        for lock in sorted(inv.values(), key=lambda l: (l.module, l.line)):
+            if lock.via_named_lock:
+                continue
+            if any(lock.module.startswith(p) for p in LOCKSMITH_PACKAGES):
+                mod = tree.get(lock.module)
+                yield Finding(
+                    self.id, lock.module, lock.line, 0,
+                    f"`{lock.attr}` is a bare threading mutex — create it "
+                    f"through `analysis.locksmith.named_lock(...)` so the "
+                    f"ALBEDO_LOCKCHECK sanitizer can track its acquisition "
+                    f"order",
+                    mod.line_text(lock.line) if mod else "",
+                )
+
+    def _check_acquire(self, tree: ProjectTree, inv: dict) -> Iterator[Finding]:
+        for rel, mod in tree.modules.items():
+
+            findings: list[Finding] = []
+
+            def visit(node: ast.AST, stack: tuple[ast.AST, ...]) -> None:
+                if not (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in ("acquire", "release")
+                ):
+                    return
+                cls = next(
+                    (a.name for a in stack if isinstance(a, ast.ClassDef)), None
+                )
+                lock = _lock_at(inv, rel, cls, node.func.value)
+                if lock is None:
+                    return
+                findings.append(Finding(
+                    self.id, rel, node.lineno, node.col_offset,
+                    f"bare `.{node.func.attr}()` on lock `{lock.name}` — "
+                    f"acquire mutexes only via `with` so every exit path "
+                    f"releases (and the sanitizer sees balanced scopes)",
+                    mod.line_text(node.lineno),
+                ))
+
+            walk_with_stack(mod.tree, visit)
+            yield from findings
+
+    def _nested_pairs(
+        self, tree: ProjectTree, graph: CallGraph, inv: dict
+    ) -> list[tuple[str, str, str, int, str]]:
+        """(outer, inner, module, line, how) for every static nested
+        acquisition: lexical ``with A: ... with B:`` plus one call-hop
+        (``with A: self.m()`` where ``m`` opens ``with B:``). Deeper dynamic
+        nesting is the runtime sanitizer's job."""
+        # Locks taken at the top of each function (any depth of its body).
+        fn_locks: dict[tuple[str, str], set[str]] = {}
+        for (rel, qual), info in graph.functions.items():
+            taken: set[str] = set()
+            for sub in ast.walk(info.node):
+                if isinstance(sub, (ast.With, ast.AsyncWith)):
+                    for item in sub.items:
+                        lock = _lock_at(inv, rel, info.class_name, item.context_expr)
+                        if lock is not None:
+                            taken.add(lock.name)
+            fn_locks[(rel, qual)] = taken
+
+        pairs: list[tuple[str, str, str, int, str]] = []
+        for (rel, qual), info in graph.functions.items():
+            mod = tree.get(rel)
+
+            def visit(node: ast.AST, stack: tuple[ast.AST, ...], _info=info) -> None:
+                held = _lexical_locks(inv, rel, _info.class_name, stack)
+                if not held:
+                    return
+                if isinstance(node, (ast.With, ast.AsyncWith)):
+                    for item in node.items:
+                        lock = _lock_at(
+                            inv, rel, _info.class_name, item.context_expr
+                        )
+                        if lock is not None:
+                            for outer in held:
+                                if outer != lock.name:
+                                    pairs.append((
+                                        outer, lock.name, rel,
+                                        node.lineno, "lexical",
+                                    ))
+                elif isinstance(node, ast.Call):
+                    callee = graph.resolve_call(_info, node)
+                    if callee is None:
+                        return
+                    for inner in fn_locks.get(
+                        (callee.module, callee.qualname), ()
+                    ):
+                        for outer in held:
+                            if outer != inner:
+                                pairs.append((
+                                    outer, inner, rel, node.lineno,
+                                    f"via {callee.qualname}",
+                                ))
+
+            walk_with_stack(info.node, visit)
+        return pairs
+
+    def _check_nesting(
+        self, tree: ProjectTree, graph: CallGraph, inv: dict
+    ) -> Iterator[Finding]:
+        if "ARCHITECTURE.md" not in tree.docs:
+            return
+        catalog = lock_order_catalog(tree)
+        lock_names = {l.name for l in inv.values()}
+        seen: set[tuple[str, str, str, int]] = set()
+        for outer, inner, rel, line, how in self._nested_pairs(tree, graph, inv):
+            if (outer, inner) in catalog:
+                continue
+            key = (rel, outer, inner, line)
+            if key in seen:
+                continue
+            seen.add(key)
+            mod = tree.get(rel)
+            inverted = (inner, outer) in catalog
+            yield Finding(
+                self.id, rel, line, 0,
+                (
+                    f"nested lock acquisition `{outer}` -> `{inner}` ({how}) "
+                    + (
+                        "INVERTS the declared lock order — this is the "
+                        "deadlock shape the catalog exists to prevent"
+                        if inverted else
+                        "is not in the ARCHITECTURE.md lock-order catalog — "
+                        "declare the order (or restructure to avoid nesting)"
+                    )
+                ),
+                mod.line_text(line) if mod else "",
+            )
+        for (a, b), lineno in sorted(catalog.items()):
+            for name in (a, b):
+                if name not in lock_names:
+                    yield Finding(
+                        self.id, "ARCHITECTURE.md", lineno, 0,
+                        f"the lock-order catalog names `{name}` but no such "
+                        f"lock exists in code — stale catalog row",
+                    )
+
+# --- R8 -----------------------------------------------------------------------
+
+
+def _lifecycle_scope(mod: Module, spawn: ThreadSpawn) -> ast.AST:
+    """Where a spawn's stop path must live: the owning class when the
+    spawn happens inside one (two classes may both bind ``self._pool`` —
+    one owner's shutdown must not alibi the other), otherwise the whole
+    module (a thread built in a factory function is legitimately joined by
+    the handle class it is handed to)."""
+    if spawn.encl_class is not None:
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.ClassDef) and node.name == spawn.encl_class:
+                return node
+    return mod.tree
+
+
+def _scope_has_call_on(scope: ast.AST, bound: str, methods: tuple[str, ...]) -> bool:
+    """Does the scope call ``.join()``/``.shutdown()``/... on something
+    whose name tail is ``bound``?"""
+    for node in ast.walk(scope):
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute) \
+                and node.func.attr in methods:
+            if last_segment(node.func.value) == bound:
+                return True
+    return False
+
+
+def _bound_name_reread(scope: ast.AST, spawn: ThreadSpawn) -> bool:
+    """The bound name is read again after the spawn (aliased into a local
+    for a racy-stop swap, handed to another owner as a call argument) —
+    the lifecycle obligation travels with the alias, so the scope-wide
+    join check below is the right evidence."""
+    for node in ast.walk(scope):
+        if isinstance(node, ast.Name) and node.id == spawn.bound_to \
+                and isinstance(node.ctx, ast.Load) and node.lineno > spawn.line:
+            return True
+        if isinstance(node, ast.Attribute) and node.attr == spawn.bound_to \
+                and isinstance(node.ctx, ast.Load) and node.lineno != spawn.line:
+            return True
+    return False
+
+
+def _scope_joins_anything(scope: ast.AST) -> bool:
+    for node in ast.walk(scope):
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "join" and not node.args:
+            return True
+    return False
+
+
+@register
+class ExecutorLifecycle(Rule):
+    id = "executor-lifecycle"
+    summary = (
+        "every spawned thread/executor has a context-managed, joined, or "
+        "explicitly handed-off shutdown path, and threads are named and "
+        "catalogued in the ARCHITECTURE.md thread inventory"
+    )
+
+    def check(self, tree: ProjectTree) -> Iterator[Finding]:
+        spawns = tree.thread_spawns()
+        doc_names = (
+            thread_inventory_doc(tree)
+            if "ARCHITECTURE.md" in tree.docs else None
+        )
+        spawned_names: set[str] = set()
+
+        for sp in spawns:
+            mod = tree.get(sp.module)
+            if mod is None:
+                continue
+            if sp.kind == "executor":
+                yield from self._check_executor(mod, sp)
+            elif sp.kind in ("thread", "timer"):
+                yield from self._check_thread(mod, sp)
+            if sp.kind == "thread":
+                if sp.name is not None:
+                    spawned_names.add(sp.name)
+                    if doc_names is not None and sp.name not in doc_names:
+                        yield Finding(
+                            self.id, sp.module, sp.line, sp.col,
+                            f"thread `{sp.name}` is missing from the "
+                            f"ARCHITECTURE.md thread-inventory table — "
+                            f"operators cannot triage a thread the "
+                            f"inventory does not list",
+                            mod.line_text(sp.line),
+                        )
+                else:
+                    yield Finding(
+                        self.id, sp.module, sp.line, sp.col,
+                        "thread spawn without a `name=` — unnameable in "
+                        "stack dumps and invisible to the ARCHITECTURE.md "
+                        "thread inventory",
+                        mod.line_text(sp.line),
+                    )
+        if doc_names is not None:
+            for name, lineno in sorted(doc_names.items()):
+                if name not in spawned_names:
+                    yield Finding(
+                        self.id, "ARCHITECTURE.md", lineno, 0,
+                        f"the thread inventory lists `{name}` but no code "
+                        f"spawns a thread with that name — stale row",
+                    )
+
+    def _check_executor(self, mod: Module, sp: ThreadSpawn) -> Iterator[Finding]:
+        if sp.context_managed:
+            return
+        if sp.bound_to is None:
+            yield Finding(
+                self.id, sp.module, sp.line, sp.col,
+                "executor constructed without a binding — nothing can ever "
+                "shut it down; use `with ThreadPoolExecutor(...) as pool:` "
+                "or store and shut it down explicitly",
+                mod.line_text(sp.line),
+            )
+            return
+        if _scope_has_call_on(
+            _lifecycle_scope(mod, sp), sp.bound_to, ("shutdown", "close")
+        ):
+            return
+        yield Finding(
+            self.id, sp.module, sp.line, sp.col,
+            f"executor bound to `{sp.bound_to}` has no reachable "
+            f"`.shutdown()` — its non-daemon workers pin the process at "
+            f"exit (the PR 12 wedged-dispatch class); context-manage it or "
+            f"shut it down in the owner's close path",
+            mod.line_text(sp.line),
+        )
+
+    def _check_thread(self, mod: Module, sp: ThreadSpawn) -> Iterator[Finding]:
+        stop_methods = ("join",) if sp.kind == "thread" else ("join", "cancel")
+        if sp.bound_to is not None:
+            scope = _lifecycle_scope(mod, sp)
+            if _scope_has_call_on(scope, sp.bound_to, stop_methods):
+                return
+            if _bound_name_reread(scope, sp) and _scope_joins_anything(scope):
+                return
+            yield Finding(
+                self.id, sp.module, sp.line, sp.col,
+                f"{sp.kind} bound to `{sp.bound_to}` is never joined"
+                f"{'/cancelled' if sp.kind == 'timer' else ''} — spawned "
+                f"work needs a reachable stop/join path (or an explicit "
+                f"handoff to an owner that joins it)",
+                mod.line_text(sp.line),
+            )
+        elif sp.daemon is not True:
+            yield Finding(
+                self.id, sp.module, sp.line, sp.col,
+                f"fire-and-forget non-daemon {sp.kind} ({sp.target_repr}) — "
+                f"unjoinable AND able to pin the interpreter; make it "
+                f"daemon or keep a handle to join",
+                mod.line_text(sp.line),
+            )

@@ -88,6 +88,8 @@ def fault_sites_in_catalog(tree: ProjectTree) -> set[str]:
         if not line.startswith("|"):
             continue
         first_cell = line.split("|")[1]
+        if "->" in first_cell or "→" in first_cell:
+            continue  # a lock-order catalog row (`a` -> `b`), not a site
         for m in _CATALOG_NAME.finditer(first_cell):
             if "." in m.group(1):
                 sites.add(m.group(1))

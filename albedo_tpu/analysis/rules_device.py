@@ -218,22 +218,42 @@ class BareJit(Rule):
 
 
 # Hot-loop roots: the training fit (resident/chunked/sharded), the LR fit,
-# the streaming fold-in, and the serving micro-batcher worker. The pipelined
-# sharded driver loop and its background prefetch uploader are roots in
-# their own right: the uploader runs on a thread the call graph cannot
-# follow (Thread(target=...)), and a hidden sync in either would stall
-# every streamed bucket.
+# the streaming fold-in, and the serving micro-batcher worker. These are the
+# DECLARED hot loops; threads they spawn (the pipelined sharded fit's
+# background prefetch uploader, for instance) are NOT listed — the call
+# graph's thread-root discovery follows `Thread(target=...)` /
+# `executor.submit(...)` references from any function reachable here and
+# adds the targets as derived roots automatically (PR 13 had to hand-patch
+# `_BucketPrefetcher._run` into this tuple; now it is derived, and the
+# anchor test pins that discovery still finds it).
 DEFAULT_HOT_ROOTS: tuple[tuple[str, str], ...] = (
     ("albedo_tpu/models/als.py", "ImplicitALS.fit"),
     ("albedo_tpu/models/als.py", "ImplicitALS._fit_chunked"),
     ("albedo_tpu/models/als.py", "ImplicitALS._fit_sharded"),
     ("albedo_tpu/models/logistic_regression.py", "LogisticRegression.fit"),
     ("albedo_tpu/parallel/als.py", "ShardedALSFit.fit"),
-    ("albedo_tpu/parallel/als.py", "ShardedALSFit._half_sweep_pipelined"),
-    ("albedo_tpu/parallel/als.py", "_BucketPrefetcher._run"),
     ("albedo_tpu/streaming/foldin.py", "FoldInEngine.fold_in"),
     ("albedo_tpu/serving/batcher.py", "MicroBatcher._run"),
 )
+
+
+def hot_roots(
+    tree: ProjectTree,
+    graph: CallGraph | None = None,
+    base: tuple[tuple[str, str], ...] = DEFAULT_HOT_ROOTS,
+    discover_threads: bool = True,
+) -> list[tuple[str, str]]:
+    """The effective R2 roots: the declared hot loops plus every thread
+    target spawned (to fixpoint) from a function reachable from them.
+    ONE definition — HiddenHostSync.check and the anchor tests both call
+    this, so the enforced surface and the tested surface cannot drift."""
+    from albedo_tpu.analysis.callgraph import derived_thread_roots
+
+    graph = graph if graph is not None else tree.callgraph()
+    roots = [r for r in base if r in graph.functions]
+    if discover_threads:
+        roots += derived_thread_roots(tree, roots, graph)
+    return roots
 
 # watchdog: its fused health reduction's single d2h read IS the designed
 # completion barrier. aot: the probe-fingerprint readback runs once at
@@ -264,13 +284,19 @@ class HiddenHostSync(Rule):
         self,
         roots: tuple[tuple[str, str], ...] = DEFAULT_HOT_ROOTS,
         allow_modules: tuple[str, ...] = DEFAULT_ALLOW_MODULES,
+        discover_threads: bool = True,
     ):
         self.roots = roots
         self.allow_modules = allow_modules
+        self.discover_threads = discover_threads
 
     def check(self, tree: ProjectTree) -> Iterator[Finding]:
-        graph = CallGraph(tree)
-        reachable = graph.reachable(list(self.roots), self.allow_modules)
+        graph = tree.callgraph()
+        roots = hot_roots(
+            tree, graph, base=self.roots,
+            discover_threads=self.discover_threads,
+        )
+        reachable = graph.reachable(roots, self.allow_modules)
         for fn in reachable:
             if fn.module in self.allow_modules:
                 continue

@@ -931,11 +931,25 @@ def serve_job(args) -> None:
           f"[{mode}, batching={'off' if ns.no_batch else 'on'}, "
           f"cache_ttl={ns.cache_ttl:g}s, "
           f"reload={'watch' if ns.reload_watch else 'on-demand'}]")
+    # Signal-interruptible foreground wait: SIGTERM/SIGINT set the stop
+    # event instead of tearing the process down mid-batch, and the finally
+    # block runs the full drain (reload watcher stopped, batcher drained,
+    # pipeline pool shut down, server thread joined) — a scheduler
+    # terminating the job gets the same clean shutdown as Ctrl-C.
+    stop = threading.Event()
+
+    def _sigstop(_sig, _frame):
+        stop.set()
+        # First signal starts the clean drain; hand the handlers back to
+        # the defaults so a SECOND Ctrl-C/SIGTERM can still kill a wedged
+        # shutdown instead of being swallowed by an already-set event.
+        for s in (signal.SIGTERM, signal.SIGINT):
+            signal.signal(s, signal.SIG_DFL)
+
+    for _sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(_sig, _sigstop)
     try:
-        if ns.duration > 0:
-            time.sleep(ns.duration)
-        else:
-            threading.Event().wait()
+        stop.wait(ns.duration if ns.duration > 0 else None)
     except KeyboardInterrupt:
         pass
     finally:
@@ -980,8 +994,8 @@ def collect_data_job(args) -> None:
     extra.add_argument("--token", default="")
     ns, _ = extra.parse_known_args(getattr(args, "_rest", []))
     with EntityStore(ns.db) as store:
-        crawler = GitHubCrawler(store, tokens=ns.token.split(","))
-        stats = crawler.collect([u for u in ns.seed_users.split(",") if u])
+        with GitHubCrawler(store, tokens=ns.token.split(",")) as crawler:
+            stats = crawler.collect([u for u in ns.seed_users.split(",") if u])
         print(f"[collect_data] {stats}")
     _report("collect_data", "requests", float(stats.requests), t0)
 

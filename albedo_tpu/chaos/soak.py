@@ -235,6 +235,27 @@ def check_invariants(art_dir: Path) -> list[str]:
     from albedo_tpu.datasets import artifacts as store
 
     violations: list[str] = []
+    # Concurrency invariant: when the soak runs with ALBEDO_LOCKCHECK=1
+    # (`make sanitize`), every lock-order inversion / unguarded shared
+    # access the sanitizer observed during the cycle is a violation — this
+    # is what validates the static ARCHITECTURE.md catalog against the
+    # behavior the chaos legs actually drive.
+    from albedo_tpu.analysis import locksmith
+
+    if locksmith.enabled():
+        # violations() is cumulative since process start; report each one
+        # in the cycle that observed it, not again in every later cycle.
+        # The cursor rides the monotonic per-violation `seq` (which
+        # survives locksmith.reset()), not list length.
+        seen = getattr(check_invariants, "_lockcheck_seen", 0)
+        recorded = locksmith.violations()
+        for v in recorded:
+            if v.get("seq", 0) > seen:
+                violations.append(f"locksmith {v['kind']}: {v['message']}")
+        if recorded:
+            check_invariants._lockcheck_seen = max(
+                seen, *(v.get("seq", 0) for v in recorded)
+            )
     if not art_dir.exists():
         return violations
     for p in sorted(art_dir.glob("*")):

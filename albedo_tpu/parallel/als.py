@@ -561,7 +561,10 @@ class _BucketPrefetcher:
                 SHARD_PREFETCH_FAULT.hit()
                 t0 = time.perf_counter()
                 dev = self._engine.put_bucket(b)
-                self._stats["upload_s"] += time.perf_counter() - t0
+                # Disjoint stats keys, one writer each: this thread owns
+                # upload_s/streamed_buckets, the consumer owns
+                # prefetch_wait_s; dict item stores are GIL-atomic.
+                self._stats["upload_s"] += time.perf_counter() - t0   # albedo: noqa[shared-state-guard]
                 self._stats["streamed_buckets"] += 1
                 self._put(("bucket", dev))
         except BaseException as e:  # noqa: BLE001 — re-raised on the consumer
@@ -589,7 +592,10 @@ class _BucketPrefetcher:
             )
         except queue.Empty:
             raise PrefetchStalled(self._deadline) from None
-        self._stats["prefetch_wait_s"] += time.perf_counter() - t0
+        # Disjoint stats key: the consumer thread is the only writer of
+        # prefetch_wait_s (the uploader owns upload_s/streamed_buckets);
+        # dict item stores are GIL-atomic.
+        self._stats["prefetch_wait_s"] += time.perf_counter() - t0  # albedo: noqa[shared-state-guard]
         if kind == "error":
             raise payload
         if kind == "done":

@@ -158,7 +158,10 @@ def _run_with_deadline(fn, deadline_s: float, detail: str = ""):
         finally:
             done.set()
 
-    t = threading.Thread(target=worker, name="albedo-elastic-chunk", daemon=True)
+    # Never joined BY DESIGN: on CollectiveTimeout the wedged dispatch is
+    # abandoned (daemon, so it cannot pin the exit) — joining it would
+    # re-create the hang the deadline exists to break.
+    t = threading.Thread(target=worker, name="albedo-elastic-chunk", daemon=True)  # albedo: noqa[executor-lifecycle]
     t.start()
     if not done.wait(deadline_s):
         raise CollectiveTimeout(deadline_s, detail)

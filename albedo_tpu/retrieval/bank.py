@@ -67,6 +67,7 @@ from typing import Callable
 
 import numpy as np
 
+from albedo_tpu.analysis.locksmith import named_lock
 from albedo_tpu.utils import events, faults
 from albedo_tpu.utils import pow2_at_least as _pow2
 
@@ -287,7 +288,7 @@ class RetrievalBank:
         self._excl_np: np.ndarray | None = None
         self._excl_dev = None
         self._executables: dict[tuple, object] = {}
-        self._exec_lock = threading.Lock()
+        self._exec_lock = named_lock("retrieval.bank.exec")
         self._overlay_owned: set[str] = set()
         self.admission = None
 

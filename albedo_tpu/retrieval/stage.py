@@ -27,6 +27,7 @@ import threading
 import numpy as np
 import pandas as pd
 
+from albedo_tpu.analysis.locksmith import named_lock
 from albedo_tpu.retrieval.bank import RetrievalBank
 from albedo_tpu.utils import events
 
@@ -64,7 +65,7 @@ class BankStage:
         # remaining stage budget), so a timed-out bank always leaves the
         # host fallback real time to answer instead of a zero-budget collect.
         self.timeout_s = float(timeout_s)
-        self._swap_lock = threading.Lock()
+        self._swap_lock = named_lock("retrieval.stage.swap")
         self.generation = 1
 
     @property

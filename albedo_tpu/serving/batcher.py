@@ -60,6 +60,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from albedo_tpu.analysis.locksmith import named_lock, note_access
 from albedo_tpu.models.als import ALSModel
 from albedo_tpu.ops.topk import topk_scores
 from albedo_tpu.utils import pow2_at_least as _pow2_bucket
@@ -187,15 +188,19 @@ class MicroBatcher:
         self._n_users = int(self._uf.shape[0])
         self._queue: "queue.Queue[_Request | object]" = queue.Queue(maxsize=max_queue)
         self._executables: dict[tuple[int, int, int], object] = {}
-        self._exec_lock = threading.Lock()
+        self._exec_lock = named_lock("serving.batcher.exec")
         self._stop = threading.Event()
         self._abort = threading.Event()
         # Guards the closed-check + enqueue in submit() against stop()'s
         # post-join drain: without it a submit could land its request AFTER
         # the drain, leaving a future nobody resolves (the HTTP thread would
         # hang its full result timeout). Held only for a put_nowait.
-        self._submit_lock = threading.Lock()
+        self._submit_lock = named_lock("serving.batcher.submit")
         self._closed = False
+        # Worker-written, HTTP-thread-read statistics (batch counts, the
+        # Retry-After EWMA) share one lock: the worker takes it once per
+        # executed batch, readers once per 429/report.
+        self._stats_lock = named_lock("serving.batcher.stats")
         self.batches_run = 0
         self.requests_served = 0
         self.warmed = False
@@ -219,7 +224,10 @@ class MicroBatcher:
         estimate for the 429 ``Retry-After`` header, not a promise."""
         depth = self._queue.qsize()
         batches_ahead = depth / self.max_batch + 1.0
-        return float(min(30.0, max(1.0, batches_ahead * self._ewma_batch_s)))
+        with self._stats_lock:
+            note_access("serving.batcher.stats_state", owner=self)
+            ewma = self._ewma_batch_s
+        return float(min(30.0, max(1.0, batches_ahead * ewma)))
 
     def submit(
         self,
@@ -327,7 +335,10 @@ class MicroBatcher:
 
     @property
     def mean_batch_size(self) -> float:
-        return self.requests_served / self.batches_run if self.batches_run else 0.0
+        with self._stats_lock:
+            note_access("serving.batcher.stats_state", owner=self)
+            served, run = self.requests_served, self.batches_run
+        return served / run if run else 0.0
 
     # ---------------------------------------------------------------- worker
 
@@ -476,10 +487,16 @@ class MicroBatcher:
             # k was quantized up for the executable; each request gets
             # exactly its own top-k back (top-j == first j of top-K).
             _resolve(req.future, (vals[i, : req.k], idx[i, : req.k]))
-        self.batches_run += 1
-        self.requests_served += len(reqs)
         batch_s = time.perf_counter() - t0
-        self._ewma_batch_s += 0.2 * (batch_s - self._ewma_batch_s)
+        with self._stats_lock:
+            # Under ALBEDO_LOCKCHECK the sanitizer verifies the R6 contract
+            # dynamically: every cross-thread touch of the stats happens
+            # with this lock held (drop the lock and `make sanitize` fails
+            # with kind=unguarded).
+            note_access("serving.batcher.stats_state", write=True, owner=self)
+            self.batches_run += 1
+            self.requests_served += len(reqs)
+            self._ewma_batch_s += 0.2 * (batch_s - self._ewma_batch_s)
         if self.metrics is not None:
             self.metrics.batch_size.observe(len(reqs))
             self.metrics.batch_latency.observe(batch_s)

@@ -44,6 +44,7 @@ import threading
 import time
 from typing import Callable
 
+from albedo_tpu.analysis.locksmith import named_lock
 from albedo_tpu.utils.retry import RetryPolicy
 
 CLOSED = "closed"
@@ -105,7 +106,7 @@ class CircuitBreaker:
         self.clock = clock
         self._rng = rng or random.Random()
         self._on_transition = on_transition
-        self._lock = threading.Lock()
+        self._lock = named_lock("serving.breaker.state")
         self._state = CLOSED
         self._consecutive_failures = 0
         self._trips = 0          # consecutive open periods (resets on close)

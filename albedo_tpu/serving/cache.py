@@ -19,6 +19,8 @@ process can never answer from the displaced model's results.
 from __future__ import annotations
 
 import threading
+
+from albedo_tpu.analysis.locksmith import named_lock
 import time
 from collections import OrderedDict
 from typing import Any, Callable, Hashable
@@ -36,7 +38,7 @@ class TTLCache:
         self.clock = clock
         # key -> (expires_at, user_id, value); OrderedDict end = most recent.
         self._data: "OrderedDict[Hashable, tuple[float, Any, Any]]" = OrderedDict()
-        self._lock = threading.Lock()
+        self._lock = named_lock("serving.cache.entries")
 
     def get(self, key: Hashable, default: Any = None) -> Any:
         now = self.clock()

@@ -47,6 +47,7 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
+from albedo_tpu.analysis.locksmith import named_lock
 from albedo_tpu.serving.batcher import BatcherClosed, QueueOverflow
 from albedo_tpu.serving.service import RecommendationService
 
@@ -293,7 +294,7 @@ class ServerHandle:
         self._thread = thread
         self._service = service
         self._down = False
-        self._lock = threading.Lock()
+        self._lock = named_lock("serving.http.handle")
 
     @property
     def server_address(self):

@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import threading
 
+from albedo_tpu.analysis.locksmith import named_lock
 from albedo_tpu.utils import events
 from albedo_tpu.utils.events import (  # noqa: F401  (re-exported API)
     DEFAULT_SIZE_BUCKETS,
@@ -36,7 +37,7 @@ class MetricsRegistry:
 
     def __init__(self):
         self._metrics: list = []
-        self._lock = threading.Lock()
+        self._lock = named_lock("serving.metrics.registry")
         # Core serving metrics, pre-registered so /metrics is stable from the
         # first scrape (counters render 0 before any traffic).
         self.requests = self.counter(

@@ -46,6 +46,7 @@ from concurrent.futures import Future, ThreadPoolExecutor, TimeoutError as Futur
 import numpy as np
 import pandas as pd
 
+from albedo_tpu.analysis.locksmith import named_lock
 from albedo_tpu.datasets.ragged import csr_row
 from albedo_tpu.datasets.star_matrix import StarMatrix
 from albedo_tpu.recommenders.base import Recommender, fuse_candidates
@@ -163,7 +164,7 @@ class TwoStagePipeline:
         if breakers_enabled and breaker_config is None:
             self.breaker_config = BreakerConfig()
         self.breakers: dict[str, CircuitBreaker] = {}
-        self._breaker_lock = threading.Lock()
+        self._breaker_lock = named_lock("serving.pipeline.breakers")
         self._pool = ThreadPoolExecutor(
             max_workers=max_workers, thread_name_prefix="albedo-pipeline"
         )

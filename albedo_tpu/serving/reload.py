@@ -63,6 +63,7 @@ from pathlib import Path
 
 import numpy as np
 
+from albedo_tpu.analysis.locksmith import named_lock
 from albedo_tpu.datasets import artifacts as artifact_store
 from albedo_tpu.models.als import ALSModel
 from albedo_tpu.serving.service import ModelGeneration, RecommendationService
@@ -164,7 +165,7 @@ class HotSwapManager:
             if n_users
             else np.zeros(0, dtype=np.int64)
         )
-        self._reload_lock = threading.Lock()  # one reload at a time
+        self._reload_lock = named_lock("serving.reload.reload")  # one reload at a time
         self._watch_stop = threading.Event()
         self._watch_thread: threading.Thread | None = None
         self._seen: dict[str, tuple[float, int]] = {}
@@ -412,7 +413,7 @@ class HotSwapManager:
         """
         with self._reload_lock:
             report = self._attempt(path)
-        self.last_report = report
+            self.last_report = report
         return report
 
     def _attempt(self, path: str | Path | None) -> dict:
@@ -653,7 +654,10 @@ class HotSwapManager:
             return
         for p in self.candidate_paths():
             st = p.stat()
-            self._seen[str(p)] = (st.st_mtime, st.st_size)
+            # Seeded BEFORE Thread.start() — the start() happens-before edge
+            # publishes the baseline to the watcher, and afterwards only the
+            # single watcher thread ever writes this dict.
+            self._seen[str(p)] = (st.st_mtime, st.st_size)  # albedo: noqa[shared-state-guard]
         self._watch_stop.clear()
         self._watch_thread = threading.Thread(
             target=self._watch_loop, name="albedo-reload-watch", daemon=True
@@ -690,7 +694,10 @@ class HotSwapManager:
         # (older) candidates are superseded, not servable downgrades.
         promoted = False
         for p, sig in reversed(changed):
-            self._seen[str(p)] = sig
+            # Single-writer after start(): only the watcher thread reaches
+            # here; the main thread's writes are the pre-start seeding,
+            # published by the Thread.start() happens-before edge.
+            self._seen[str(p)] = sig  # albedo: noqa[shared-state-guard]
             if promoted:
                 continue
             report = self.request_reload(p)

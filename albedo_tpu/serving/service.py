@@ -43,6 +43,7 @@ from concurrent.futures import TimeoutError as FutureTimeout
 import numpy as np
 import pandas as pd
 
+from albedo_tpu.analysis.locksmith import named_lock
 from albedo_tpu.datasets.ragged import csr_row, padded_rows
 from albedo_tpu.datasets.star_matrix import StarMatrix
 from albedo_tpu.models.als import ALSModel
@@ -116,7 +117,7 @@ class RecommendationService:
         self.max_k = int(max_k)
         self.item_block = int(item_block)
         self._closed = False
-        self._close_lock = threading.Lock()
+        self._close_lock = named_lock("serving.service.close")
         # Batcher construction parameters, kept so the hot-swap manager can
         # build a candidate generation's batcher identically configured.
         self._batching = bool(batching)
@@ -176,7 +177,7 @@ class RecommendationService:
         # + in-flight requests holding its snapshot) until the manager
         # retires it; close() sweeps whatever is left.
         self._zombie_batchers: list[MicroBatcher] = []
-        self._gen_lock = threading.Lock()
+        self._gen_lock = named_lock("serving.service.gen")
         self._generation = self.build_generation(
             model,
             number=1 if model is not None else 0,

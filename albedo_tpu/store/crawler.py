@@ -37,6 +37,7 @@ import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Callable, Iterable
 
+from albedo_tpu.analysis.locksmith import named_lock
 from albedo_tpu.store.store import EntityStore
 from albedo_tpu.utils import faults
 from albedo_tpu.utils.retry import RetriesExhausted, RetryAfter, RetryPolicy, retry_call
@@ -211,8 +212,21 @@ class GitHubCrawler:
         self._backoff_rng = random.Random(seed + 1)  # jitter stream, lock-free
         # _request runs on the page-fetch pool: stats increments and the
         # shared rng need a lock (Python += is not atomic).
-        self._lock = threading.Lock()
+        self._lock = named_lock("store.crawler.stats")
         self._pool = ThreadPoolExecutor(concurrency)
+
+    def close(self) -> None:
+        """Shut the page-fetch pool down (idempotent). Without this a
+        dropped crawler leaves non-daemon pool workers to be reaped only by
+        the interpreter's atexit hook — the wedged-exit class the
+        executor-lifecycle lint polices."""
+        self._pool.shutdown(wait=True, cancel_futures=True)
+
+    def __enter__(self) -> "GitHubCrawler":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
 
     # --- request core (:50-68) ----------------------------------------------
 

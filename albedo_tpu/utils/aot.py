@@ -52,6 +52,8 @@ import json
 import logging
 import os
 import threading
+
+from albedo_tpu.analysis.locksmith import named_lock
 import time
 from collections import OrderedDict
 from pathlib import Path
@@ -66,7 +68,7 @@ class LRUCache:
     def __init__(self, maxsize: int = 8):
         self.maxsize = max(1, int(maxsize))
         self._data: OrderedDict = OrderedDict()
-        self._lock = threading.Lock()
+        self._lock = named_lock("utils.aot.memcache")
 
     def get(self, key, default=None):
         with self._lock:
@@ -97,7 +99,7 @@ class LRUCache:
 
 _EXECUTABLES = LRUCache(maxsize=int(os.environ.get("ALBEDO_AOT_MEMORY_SLOTS", "8")))
 # Serializes the XLA-cache bypass toggle (see _compile_bypassing_xla_cache).
-_BYPASS_LOCK = threading.Lock()
+_BYPASS_LOCK = named_lock("utils.aot.bypass")
 
 
 def reset_memory_cache() -> None:

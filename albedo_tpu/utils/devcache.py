@@ -23,11 +23,13 @@ object hold ONE device copy between them.
 from __future__ import annotations
 
 import threading
+
+from albedo_tpu.analysis.locksmith import named_lock
 import weakref
 from typing import Any
 
 _CACHES: dict[int, tuple[weakref.ref, dict]] = {}
-_LOCK = threading.Lock()
+_LOCK = named_lock("utils.devcache.entries")
 
 
 def owner_cache(owner: Any) -> dict:

@@ -61,7 +61,10 @@ class Counter:
         self.help = help_
         self.label_names = tuple(label_names)
         self._values: dict[tuple[str, ...], float] = {}
-        self._lock = threading.Lock()
+        # Leaf metric-primitive lock, one per counter instance, never held
+        # across another acquisition — tracking hundreds of these would
+        # bloat the sanitizer graph for zero ordering signal.
+        self._lock = threading.Lock()  # albedo: noqa[lock-discipline]
 
     def inc(self, amount: float = 1.0, **labels: str) -> None:
         key = tuple(str(labels.get(n, "")) for n in self.label_names)
@@ -124,7 +127,8 @@ class Histogram:
         self._counts = [0] * (len(self.buckets) + 1)  # +1 for +Inf
         self._sum = 0.0
         self._count = 0
-        self._lock = threading.Lock()
+        # Leaf primitive lock — see Counter.__init__ for why it stays bare.
+        self._lock = threading.Lock()  # albedo: noqa[lock-discipline]
 
     def observe(self, value: float) -> None:
         value = float(value)
@@ -226,6 +230,9 @@ RETRIEVAL_QUERIES_TOTAL = "albedo_retrieval_queries_total"
 RETRIEVAL_FALLBACKS_TOTAL = "albedo_retrieval_fallbacks_total"
 RETRIEVAL_PROMOTIONS_TOTAL = "albedo_retrieval_promotions_total"
 
+# Concurrency sanitizer (analysis/locksmith.py, ALBEDO_LOCKCHECK=1).
+LOCKCHECK_VIOLATIONS_TOTAL = "albedo_lockcheck_violations_total"
+
 METRIC_NAMES: frozenset = frozenset(
     v for k, v in list(globals().items())
     if k.isupper() and isinstance(v, str) and v.startswith("albedo_")
@@ -234,7 +241,9 @@ METRIC_NAMES: frozenset = frozenset(
 
 # --- process-global offline counters -----------------------------------------
 
-_global_lock = threading.Lock()
+# Held only around registry-dict access; counter construction under it
+# acquires nothing — a leaf like the per-counter locks above.
+_global_lock = threading.Lock()  # albedo: noqa[lock-discipline]
 _global_metrics: dict[str, Counter] = {}
 
 
@@ -388,4 +397,13 @@ retrieval_promotions = global_counter(
     RETRIEVAL_PROMOTIONS_TOTAL,
     "Retrieval-bank generation swaps, by outcome (promoted/rejected).",
     ("outcome",),
+)
+# The lock-order sanitizer (graftlint's runtime complement): inversions,
+# self-deadlocks, and unguarded shared-state accesses observed under
+# ALBEDO_LOCKCHECK=1. Stays at zero in every green sanitize/soak run.
+lockcheck_violations = global_counter(
+    LOCKCHECK_VIOLATIONS_TOTAL,
+    "Lock-order / unguarded-shared-state violations observed by the "
+    "ALBEDO_LOCKCHECK sanitizer, by kind (order/self-deadlock/unguarded).",
+    ("kind",),
 )

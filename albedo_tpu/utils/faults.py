@@ -60,6 +60,7 @@ import threading
 import time
 from pathlib import Path
 
+from albedo_tpu.analysis.locksmith import named_lock
 from albedo_tpu.utils import events
 
 _ENV_VAR = "ALBEDO_FAULTS"
@@ -133,7 +134,7 @@ class FaultRegistry:
     """Hit counters + armed specs for every named site (thread-safe)."""
 
     def __init__(self, env: str | None = None):
-        self._lock = threading.Lock()
+        self._lock = named_lock("utils.faults.registry")
         self._specs: dict[str, list[FaultSpec]] = {}
         self._hits: dict[str, int] = {}
         self._fired: dict[str, int] = {}

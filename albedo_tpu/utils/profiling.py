@@ -14,6 +14,8 @@ from __future__ import annotations
 import contextlib
 import functools
 import threading
+
+from albedo_tpu.analysis.locksmith import named_lock
 import time
 from typing import Any, Callable, Iterator
 
@@ -43,7 +45,7 @@ class Timer:
         # threads; the read-modify-write below would lose increments
         # unlocked. Uncontended acquisition is ~100 ns — noise against the
         # device work the sections time.
-        self._lock = threading.Lock()
+        self._lock = named_lock("utils.profiling.timer")
 
     @contextlib.contextmanager
     def section(self, name: str, sync: Any = None) -> Iterator[None]:

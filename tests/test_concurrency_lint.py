@@ -1,0 +1,302 @@
+"""graftlint concurrency tier (R6-R8) + thread-root discovery + parse cache.
+
+Mirrors tests/test_graftlint.py's layers for the new tier:
+
+1. **Fixture proofs** — each rule fires on its committed ``*_bad`` shapes
+   and stays silent on the near-identical ``*_ok``/pragma'd ones.
+2. **Discovery** — thread spawn sites on the real tree (the inventory's
+   ground truth), instantiation-edge reachability, and the derived hot
+   roots that replaced the hand-listed `DEFAULT_HOT_ROOTS` thread entries.
+3. **Anchors** — the real tree's lock inventory, lock-order catalog, and
+   thread inventory round-trip against ARCHITECTURE.md.
+4. **Parse cache** — warm loads reuse unchanged files, mtime/size changes
+   invalidate, corrupt caches are ignored.
+"""
+
+import os
+import time
+from pathlib import Path
+
+from albedo_tpu.analysis import ProjectTree, collect_findings, default_tree
+from albedo_tpu.analysis.callgraph import derived_thread_roots
+from albedo_tpu.analysis.core import CACHE_NAME
+from albedo_tpu.analysis.rules_concurrency import (
+    lock_inventory,
+    lock_order_catalog,
+    thread_inventory_doc,
+)
+from albedo_tpu.analysis.rules_device import DEFAULT_HOT_ROOTS, hot_roots
+
+FIXTURES = Path(__file__).resolve().parent.parent / (
+    "albedo_tpu/analysis/fixtures"
+)
+
+
+def run_rule(name: str, rule_id: str):
+    return collect_findings(ProjectTree.load(FIXTURES / name), rule_ids=[rule_id])
+
+
+# --- 1. fixture proofs --------------------------------------------------------
+
+
+def test_shared_state_guard_fires_on_fixture():
+    findings = run_rule("shared_state", "shared-state-guard")
+    msgs = [f.message for f in findings]
+    assert any("self.processed" in m for m in msgs), msgs
+    assert any("`_COUNT`" in m and "bump_unguarded" in m for m in msgs), msgs
+    # A locked intra-class caller of the thread target must not launder
+    # the bare thread entry away (Restarter.restart holds the lock, the
+    # spawned thread holds nothing).
+    assert any("self.ticks" in m and "Restarter" in m for m in msgs), msgs
+    # Guarded writes (lexical + the *_locked caller-intersection pattern),
+    # primitives (queue/Event), publish-once __init__ state, the guarded
+    # global, and the pragma'd counter all stay silent.
+    joined = "\n".join(msgs)
+    for silent in ("latency", "_results", "config", "_TOTAL", "debug_marks", "_q"):
+        assert silent not in joined, (silent, msgs)
+    assert len(findings) == 3, [f.render() for f in findings]
+
+
+def test_lock_discipline_fires_on_fixture():
+    findings = run_rule("lock_discipline", "lock-discipline")
+    msgs = [f.message for f in findings]
+
+    def has(*subs):
+        return any(all(s in m for s in subs) for m in msgs)
+
+    assert has("`_bare`", "named_lock")
+    assert has("`fix.inner` -> `fix.outer`", "INVERTS")
+    assert has("`fix.outer` -> `fix.stray`", "not in the ARCHITECTURE.md")
+    assert has("bare `.acquire()`", "`fix.outer`")
+    assert has("bare `.release()`", "`fix.outer`")
+    assert has("`fix.ghost`", "stale catalog row")
+    # The declared direction — lexical AND through the one-hop call — and
+    # the named_lock creations stay silent. So does the joined non-daemon
+    # worker: the daemon obligation lives in R8, conditioned on the spawn
+    # lacking a join path — R7 must not second-guess a joined thread.
+    assert not has("`fix.outer` -> `fix.inner`")
+    assert not has("daemon")
+    assert len(findings) == 6, [f.render() for f in findings]
+
+
+def test_executor_lifecycle_fires_on_fixture():
+    findings = run_rule("executor_lifecycle", "executor-lifecycle")
+    msgs = [f.message for f in findings]
+
+    def has(*subs):
+        return any(all(s in m for s in subs) for m in msgs)
+
+    assert has("executor constructed without a binding")
+    assert has("executor bound to `_pool`", "no reachable `.shutdown()`")
+    assert has("thread bound to `_thread` is never joined")
+    assert has("fire-and-forget non-daemon")
+    assert has("`fix-forgotten`", "missing from")
+    assert has("`fix-phantom`", "stale row")
+    # OwnedPool (close() shuts down), the with-managed pool, Looper's
+    # joined thread, and serve_ok's handed-off+joined server stay silent.
+    assert not has("`fix-server`")
+    assert not has("`fix-looper`")
+    assert len(findings) == 6, [f.render() for f in findings]
+
+
+# --- 2. thread-root discovery on the real tree --------------------------------
+
+
+def test_discovery_sees_every_known_spawn_site():
+    tree = default_tree()
+    spawns = tree.thread_spawns()
+    threads = {s.name for s in spawns if s.kind == "thread"}
+    assert threads == {
+        "albedo-micro-batcher", "albedo-http", "albedo-reload-watch",
+        "albedo-sighup-reload", "albedo-shard-prefetch",
+        "albedo-elastic-chunk",
+    }
+    # Every Thread spawn in the tree is daemonized (the PR 12 invariant).
+    assert all(s.daemon for s in spawns if s.kind == "thread")
+    # Executor constructions: the pipeline pools, the crawler pool, and the
+    # with-managed host-side pools.
+    ex_modules = {s.module for s in spawns if s.kind == "executor"}
+    assert "albedo_tpu/serving/pipeline.py" in ex_modules
+    assert "albedo_tpu/store/crawler.py" in ex_modules
+    assert "albedo_tpu/datasets/ragged.py" in ex_modules
+
+
+def test_prefetcher_run_is_a_derived_root_not_hand_listed():
+    """The satellite: PR 13's hand-patched thread entries are now derived.
+    `_BucketPrefetcher._run` must NOT be in the static tuple, and MUST be
+    found by discovery through fit -> _half_sweep_pipelined ->
+    _BucketPrefetcher() -> Thread(target=self._run)."""
+    assert ("albedo_tpu/parallel/als.py", "_BucketPrefetcher._run") \
+        not in DEFAULT_HOT_ROOTS
+    assert ("albedo_tpu/parallel/als.py", "ShardedALSFit._half_sweep_pipelined") \
+        not in DEFAULT_HOT_ROOTS
+    tree = default_tree()
+    derived = derived_thread_roots(tree, list(DEFAULT_HOT_ROOTS), tree.callgraph())
+    assert ("albedo_tpu/parallel/als.py", "_BucketPrefetcher._run") in derived
+    roots = hot_roots(tree)
+    assert ("albedo_tpu/parallel/als.py", "_BucketPrefetcher._run") in roots
+    # And the driver loop stays covered through plain reachability.
+    reached = {
+        (f.module, f.qualname)
+        for f in tree.callgraph().reachable(roots)
+    }
+    assert ("albedo_tpu/parallel/als.py", "ShardedALSFit._half_sweep_pipelined") \
+        in reached
+
+
+def test_instantiation_edges_reach_init():
+    """`Foo(...)` resolves to `Foo.__init__` — without this edge the
+    prefetcher's spawn site (inside its __init__) would be invisible."""
+    tree = default_tree()
+    graph = tree.callgraph()
+    reached = {
+        (f.module, f.qualname)
+        for f in graph.reachable([("albedo_tpu/parallel/als.py", "ShardedALSFit.fit")])
+    }
+    assert ("albedo_tpu/parallel/als.py", "_BucketPrefetcher.__init__") in reached
+
+
+def test_fixture_thread_roots_follow_into_spawned_code(tmp_path):
+    """R2 through a spawned thread: a hidden sync inside a thread target
+    spawned from a hot root is flagged without hand-listing the target."""
+    root = tmp_path / "repo"
+    (root / "albedo_tpu/models").mkdir(parents=True)
+    (root / "albedo_tpu/models/hot.py").write_text(
+        "import threading\n"
+        "\n"
+        "\n"
+        "class Fit:\n"
+        "    def fit(self, xs):\n"
+        "        t = threading.Thread(target=self._feed, args=(xs,),\n"
+        "                             name='fix-feed', daemon=True)\n"
+        "        t.start()\n"
+        "        t.join()\n"
+        "\n"
+        "    def _feed(self, xs):\n"
+        "        for x in xs:\n"
+        "            x.tolist()\n"
+    )
+    from albedo_tpu.analysis.rules_device import HiddenHostSync
+
+    tree = ProjectTree.load(root)
+    rule = HiddenHostSync(
+        roots=(("albedo_tpu/models/hot.py", "Fit.fit"),), allow_modules=()
+    )
+    findings = collect_findings(tree, rules=[rule])
+    assert len(findings) == 1 and ".tolist()" in findings[0].message
+    # Discovery off -> the thread body is invisible (the pre-tier blind spot).
+    blind = HiddenHostSync(
+        roots=(("albedo_tpu/models/hot.py", "Fit.fit"),), allow_modules=(),
+        discover_threads=False,
+    )
+    assert collect_findings(tree, rules=[blind]) == []
+
+
+# --- 3. anchors against the real tree -----------------------------------------
+
+
+def test_real_lock_inventory_is_locksmith_named():
+    inv = lock_inventory(default_tree())
+    names = {l.name for l in inv.values()}
+    for expected in (
+        "serving.batcher.exec", "serving.batcher.submit",
+        "serving.batcher.stats", "serving.service.gen",
+        "serving.reload.reload", "serving.breaker.state",
+        "retrieval.bank.exec", "retrieval.stage.swap",
+        "utils.aot.memcache", "utils.aot.bypass", "utils.devcache.entries",
+        "utils.faults.registry", "store.crawler.stats",
+    ):
+        assert expected in names, f"{expected} missing from the lock inventory"
+    assert len(names) >= 18
+
+
+def test_real_lock_catalog_round_trips():
+    tree = default_tree()
+    catalog = lock_order_catalog(tree)
+    assert catalog, "ARCHITECTURE.md lock-order catalog missing/empty"
+    names = {l.name for l in lock_inventory(tree).values()}
+    for a, b in catalog:
+        assert a in names, f"catalog names unknown lock {a}"
+        assert b in names, f"catalog names unknown lock {b}"
+    assert ("serving.reload.reload", "serving.service.gen") in catalog
+
+
+def test_real_thread_inventory_round_trips():
+    tree = default_tree()
+    doc = thread_inventory_doc(tree)
+    spawned = {s.name for s in tree.thread_spawns() if s.kind == "thread"}
+    assert set(doc) == spawned
+
+
+# --- 4. the parse cache -------------------------------------------------------
+
+
+def _mini_repo(tmp_path) -> Path:
+    root = tmp_path / "repo"
+    (root / "albedo_tpu").mkdir(parents=True)
+    (root / "albedo_tpu/a.py").write_text("X = 1\n")
+    (root / "albedo_tpu/b.py").write_text("Y = 2\n")
+    return root
+
+
+def test_parse_cache_hits_and_invalidates(tmp_path, monkeypatch):
+    import ast as ast_module
+
+    root = _mini_repo(tmp_path)
+    ProjectTree.load(root, cache=True)
+    assert (root / CACHE_NAME).exists()
+
+    real_parse = ast_module.parse
+    parses: list = []
+
+    def counting_parse(src, *a, **k):
+        parses.append(k.get("filename"))
+        return real_parse(src, *a, **k)
+
+    monkeypatch.setattr(ast_module, "parse", counting_parse)
+
+    t2 = ProjectTree.load(root, cache=True)
+    assert parses == [], "warm load must not re-parse unchanged files"
+    assert t2.modules["albedo_tpu/a.py"].source == "X = 1\n"
+
+    # A content change (mtime+size key) re-parses just that file.
+    time.sleep(0.01)
+    (root / "albedo_tpu/a.py").write_text("X = 111\n")
+    t3 = ProjectTree.load(root, cache=True)
+    assert len(parses) == 1
+    assert t3.modules["albedo_tpu/a.py"].source == "X = 111\n"
+    assert t3.modules["albedo_tpu/b.py"].source == "Y = 2\n"
+
+    # An mtime bump alone (touch) also invalidates — conservative key.
+    time.sleep(0.01)
+    os.utime(root / "albedo_tpu/b.py")
+    ProjectTree.load(root, cache=True)
+    assert len(parses) == 2
+
+
+def test_parse_cache_reuses_modules_across_processes_shape(tmp_path):
+    """The cache payload round-trips Module objects (ast + pragmas) — the
+    thing a warm `make lint` skips re-building."""
+    root = _mini_repo(tmp_path)
+    (root / "albedo_tpu/a.py").write_text(
+        "import threading\n"
+        "L = threading.Lock()  # albedo: noqa[lock-discipline]\n"
+    )
+    ProjectTree.load(root, cache=True)
+    warm = ProjectTree.load(root, cache=True)
+    mod = warm.modules["albedo_tpu/a.py"]
+    assert mod.suppressed("lock-discipline", 2)
+    assert mod.tree.body  # the AST came back usable
+
+
+def test_parse_cache_ignores_corruption_and_library_default_off(tmp_path):
+    root = _mini_repo(tmp_path)
+    (root / CACHE_NAME).write_bytes(b"not a pickle")
+    tree = ProjectTree.load(root, cache=True)  # corrupt cache -> full parse
+    assert set(tree.modules) == {"albedo_tpu/a.py", "albedo_tpu/b.py"}
+
+    clean = tmp_path / "clean"
+    (clean / "albedo_tpu").mkdir(parents=True)
+    (clean / "albedo_tpu/c.py").write_text("Z = 3\n")
+    ProjectTree.load(clean)  # default: library loads never write caches
+    assert not (clean / CACHE_NAME).exists()

@@ -211,6 +211,8 @@ def test_rule_registry_is_complete():
     assert set(all_rules()) == {
         "bare-jit", "hidden-host-sync", "contract-drift",
         "dtype-discipline", "retrace-hazard",
+        # The concurrency tier (tests/test_concurrency_lint.py).
+        "shared-state-guard", "lock-discipline", "executor-lifecycle",
     }
 
 
@@ -250,17 +252,21 @@ def test_aot_fed_names_see_the_real_surface():
 
 
 def test_hot_loop_reachability_sees_the_real_surface():
-    graph = CallGraph(default_tree())
+    from albedo_tpu.analysis.rules_device import hot_roots
+
+    tree = default_tree()
+    graph = CallGraph(tree)
     reached = {
         (f.module, f.qualname)
-        for f in graph.reachable(list(DEFAULT_HOT_ROOTS))
+        for f in graph.reachable(hot_roots(tree, graph))
     }
     assert ("albedo_tpu/models/als.py", "ImplicitALS.fit") in reached
     assert ("albedo_tpu/serving/batcher.py", "MicroBatcher._execute") in reached
     assert ("albedo_tpu/streaming/foldin.py", "FoldInEngine._solve_chunk") in reached
-    # The pipelined driver loop and the background prefetch uploader are
-    # hot roots themselves (the uploader runs on a thread the call graph
-    # cannot follow), and the driver's bucket path is reachable.
+    # The pipelined driver loop is reachable from ShardedALSFit.fit, and
+    # the background prefetch uploader — which the call graph cannot
+    # follow onto (Thread(target=...)) — is a DERIVED hot root from the
+    # thread-root discovery, no longer hand-listed (PR 13's entries).
     assert (
         "albedo_tpu/parallel/als.py", "ShardedALSFit._half_sweep_pipelined"
     ) in reached

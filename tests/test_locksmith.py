@@ -1,0 +1,374 @@
+"""locksmith (analysis/locksmith.py): the ALBEDO_LOCKCHECK sanitizer.
+
+Three layers:
+
+1. **Mechanics** — named_lock passthrough when disabled, tracked wrapper
+   when armed, balanced with/acquire/release, reentrant RLocks.
+2. **Detection** — the seeded ABBA inversion (the acceptance drill: a
+   deliberate lock-order cycle IS detected), self-deadlock raises,
+   consistent ordering stays silent, unguarded-shared-access on
+   note_access'd objects, violations counted in
+   albedo_lockcheck_violations_total{kind=}.
+3. **Integration** — the micro-batcher runs a real concurrent load with
+   the sanitizer armed and stays violation-free, and every observed edge
+   between catalogued locks matches the ARCHITECTURE.md lock-order
+   catalog's direction (the static<->runtime round-trip).
+"""
+
+import threading
+
+import pytest
+
+from albedo_tpu.analysis import locksmith
+from albedo_tpu.analysis.locksmith import (
+    LOCKCHECK_KIND_ORDER,
+    LOCKCHECK_KIND_SELF,
+    LOCKCHECK_KIND_UNGUARDED,
+    LockOrderViolation,
+    _TrackedLock,
+    named_lock,
+)
+
+
+@pytest.fixture
+def armed(monkeypatch):
+    monkeypatch.setenv("ALBEDO_LOCKCHECK", "1")
+    locksmith.reset()
+    yield
+    locksmith.reset()
+
+
+# --- 1. mechanics -------------------------------------------------------------
+
+
+def test_named_lock_is_plain_when_disabled(monkeypatch):
+    monkeypatch.delenv("ALBEDO_LOCKCHECK", raising=False)
+    lock = named_lock("test.plain")
+    assert type(lock) is type(threading.Lock())
+    rlock = named_lock("test.plain.r", reentrant=True)
+    assert type(rlock) is type(threading.RLock())
+
+
+def test_named_lock_is_tracked_when_armed(armed):
+    lock = named_lock("test.tracked")
+    assert isinstance(lock, _TrackedLock)
+    with lock:
+        assert lock.locked()
+    assert not lock.locked()
+    assert lock.acquire(timeout=1.0)
+    lock.release()
+    assert locksmith.violations() == []
+
+
+def test_reentrant_tracked_lock(armed):
+    lock = named_lock("test.reentrant", reentrant=True)
+    with lock:
+        with lock:  # no self-deadlock report for an RLock
+            pass
+    assert locksmith.violations() == []
+
+
+# --- 2. detection -------------------------------------------------------------
+
+
+def test_consistent_order_is_silent(armed):
+    a, b = named_lock("test.a"), named_lock("test.b")
+
+    def use():
+        for _ in range(5):
+            with a:
+                with b:
+                    pass
+
+    threads = [threading.Thread(target=use, daemon=True) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert locksmith.violations() == []
+    assert ("test.a", "test.b") in locksmith.order_edges()
+
+
+def test_seeded_abba_inversion_is_detected(armed):
+    """The acceptance drill: a deliberate lock-order inversion must be
+    caught. Thread 1 takes a->b, thread 2 takes b->a; the second ordering
+    to land records an `order` violation (no actual deadlock needed — the
+    graph check fires on the edge, not on the block)."""
+    a, b = named_lock("test.inv.a"), named_lock("test.inv.b")
+    gate = threading.Barrier(2, timeout=10.0)
+
+    def ab():
+        with a:
+            with b:
+                pass
+        gate.wait()  # both orders recorded before the threads exit
+        return None
+
+    def ba():
+        gate.wait()  # a->b lands first, deterministically
+        with b:
+            with a:
+                pass
+
+    t1 = threading.Thread(target=ab, daemon=True)
+    t2 = threading.Thread(target=ba, daemon=True)
+    t1.start(); t2.start()
+    t1.join(10.0); t2.join(10.0)
+    kinds = [v["kind"] for v in locksmith.violations()]
+    assert LOCKCHECK_KIND_ORDER in kinds, locksmith.violations()
+    v = next(v for v in locksmith.violations() if v["kind"] == LOCKCHECK_KIND_ORDER)
+    assert {v["acquiring"], v["holding"]} == {"test.inv.a", "test.inv.b"}
+
+
+def test_self_deadlock_raises_instead_of_hanging(armed):
+    lock = named_lock("test.self")
+    with lock:
+        with pytest.raises(LockOrderViolation):
+            lock.acquire()
+    assert [v["kind"] for v in locksmith.violations()] == [LOCKCHECK_KIND_SELF]
+
+
+def test_unguarded_shared_access_detected(armed):
+    """note_access: two threads, at least one write, no common lock."""
+    done = threading.Event()
+
+    def writer():
+        locksmith.note_access("test.shared.obj", write=True)
+        done.set()
+
+    t = threading.Thread(target=writer, daemon=True)
+    t.start(); t.join(10.0)
+    assert done.wait(10.0)
+    locksmith.note_access("test.shared.obj", write=False)  # main thread
+    kinds = [v["kind"] for v in locksmith.violations()]
+    assert kinds == [LOCKCHECK_KIND_UNGUARDED]
+
+
+def test_owner_scoped_records_are_per_instance(armed):
+    """Two instances each writing under their OWN lock instance share no
+    lock by construction (a live batcher + a reload candidate's) — with
+    ``owner=`` scoping that must NOT read as an unguarded violation,
+    while two threads on the SAME owner with no common lock still must."""
+
+    class Box:
+        def __init__(self, tag):
+            self.lock = named_lock("test.owner.stats")
+
+        def touch(self):
+            with self.lock:
+                locksmith.note_access("test.owner.state", write=True, owner=self)
+
+    b1, b2 = Box("a"), Box("b")
+    t1 = threading.Thread(target=b1.touch, daemon=True)
+    t2 = threading.Thread(target=b2.touch, daemon=True)
+    t1.start(); t2.start(); t1.join(10.0); t2.join(10.0)
+    assert locksmith.violations() == []
+
+    # Same owner, no common lock: still caught.
+    t3 = threading.Thread(
+        target=lambda: locksmith.note_access(
+            "test.owner.state", write=True, owner=b1
+        ),
+        daemon=True,
+    )
+    t3.start(); t3.join(10.0)
+    assert [v["kind"] for v in locksmith.violations()] == [
+        LOCKCHECK_KIND_UNGUARDED
+    ]
+
+
+def test_thread_records_keyed_by_object_not_ident(armed):
+    """CPython reuses thread idents after exit; records must not merge a
+    dead worker's lockset into an unrelated new thread (which would hide a
+    real race behind ``len(threads) < 2``). Keying by the Thread object
+    keeps every worker distinct however idents recycle."""
+    lock = named_lock("test.ident.lock")
+
+    def guarded_writer():
+        with lock:
+            locksmith.note_access("test.ident.obj", write=True)
+
+    def unguarded_writer():
+        locksmith.note_access("test.ident.obj", write=True)
+
+    # Run sequentially so CPython is FREE to hand the second thread the
+    # first one's ident — with get_ident keying these could merge into one
+    # record and the empty intersection would go unreported.
+    t1 = threading.Thread(target=guarded_writer, daemon=True)
+    t1.start(); t1.join(10.0)
+    t2 = threading.Thread(target=unguarded_writer, daemon=True)
+    t2.start(); t2.join(10.0)
+    with locksmith._STATE.guard:
+        rec = locksmith._STATE.shared["test.ident.obj"]
+        assert len(rec["threads"]) == 2, "threads merged — ident-keyed records"
+    assert [v["kind"] for v in locksmith.violations()] == [
+        LOCKCHECK_KIND_UNGUARDED
+    ]
+
+
+def test_guarded_shared_access_is_silent(armed):
+    lock = named_lock("test.shared.guard")
+
+    def writer():
+        with lock:
+            locksmith.note_access("test.shared.ok", write=True)
+
+    t = threading.Thread(target=writer, daemon=True)
+    t.start(); t.join(10.0)
+    with lock:
+        locksmith.note_access("test.shared.ok", write=True)
+    assert locksmith.violations() == []
+
+
+def test_reentrant_locked_mirrors_untracked_rlock(armed):
+    """The wrapper promises API parity with what named_lock would return
+    untracked: RLock has no .locked() before Python 3.12, so the tracked
+    flavor must raise AttributeError there, not crash mid-check."""
+    r = named_lock("test.re.locked", reentrant=True)
+    if hasattr(threading.RLock(), "locked"):
+        assert r.locked() is False
+    else:
+        with pytest.raises(AttributeError):
+            r.locked()
+
+
+def test_soak_invariant_reports_each_violation_once(armed, tmp_path):
+    """locksmith.violations() is cumulative; the soak invariant sweep must
+    attribute a violation to the cycle that observed it, not re-report it
+    in every later cycle."""
+    from albedo_tpu.chaos.soak import check_invariants
+
+    check_invariants._lockcheck_seen = 0
+    a, b = named_lock("test.soak.a"), named_lock("test.soak.b")
+    with a:
+        with b:
+            pass
+    with b:
+        with a:
+            pass
+    first = [v for v in check_invariants(tmp_path) if "locksmith" in v]
+    second = [v for v in check_invariants(tmp_path) if "locksmith" in v]
+    assert len(first) == 1 and second == [], (first, second)
+    # A reset between cycles (fresh sanitizer epoch) starts the cursor over.
+    locksmith.reset()
+    with b:
+        with a:
+            pass
+    with a:
+        with b:
+            pass
+    third = [v for v in check_invariants(tmp_path) if "locksmith" in v]
+    assert len(third) == 1, third
+
+
+def test_violations_counted_in_metric(armed):
+    from albedo_tpu.utils import events
+
+    counter = events.global_counter(
+        events.LOCKCHECK_VIOLATIONS_TOTAL, "", ("kind",)
+    )
+    before = counter.value(kind=LOCKCHECK_KIND_ORDER)
+    a, b = named_lock("test.m.a"), named_lock("test.m.b")
+    with a:
+        with b:
+            pass
+    with b:
+        with a:  # same-thread inversion: still an ABBA shape
+            pass
+    assert any(v["kind"] == LOCKCHECK_KIND_ORDER for v in locksmith.violations())
+    assert counter.value(kind=LOCKCHECK_KIND_ORDER) == before + 1
+
+
+def test_reset_clears_everything(armed):
+    a, b = named_lock("test.r.a"), named_lock("test.r.b")
+    with a:
+        with b:
+            pass
+    assert locksmith.order_edges()
+    locksmith.reset()
+    assert locksmith.order_edges() == set()
+    assert locksmith.violations() == []
+
+
+# --- 3. integration -----------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def als_artifacts():
+    jax = pytest.importorskip("jax")  # noqa: F841
+    from albedo_tpu.datasets import synthetic_tables
+    from albedo_tpu.models.als import ImplicitALS
+
+    tables = synthetic_tables(n_users=60, n_items=40, mean_stars=6, seed=7)
+    matrix = tables.star_matrix()
+    model = ImplicitALS(rank=8, max_iter=2, seed=0).fit(matrix)
+    return matrix, model
+
+
+def test_batcher_under_locksmith_is_violation_free(armed, als_artifacts):
+    """The real micro-batcher (its locks created through named_lock AFTER
+    the env is set) under a concurrent submit load: no inversions, no
+    self-deadlocks — the tier-1 copy of the `make sanitize` invariant."""
+    import numpy as np
+
+    from albedo_tpu.serving.batcher import MicroBatcher
+
+    matrix, model = als_artifacts
+    batcher = MicroBatcher(model, window_ms=2.0)
+    assert isinstance(batcher._exec_lock, _TrackedLock)
+    try:
+        def load(seed):
+            rng = np.random.default_rng(seed)
+            futs = [
+                batcher.submit(int(rng.integers(0, matrix.n_users)), 5)
+                for _ in range(10)
+            ]
+            for f in futs:
+                f.result(timeout=30)
+            batcher.retry_after_s()
+            _ = batcher.mean_batch_size
+
+        threads = [
+            threading.Thread(target=load, args=(i,), daemon=True)
+            for i in range(4)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(30.0)
+    finally:
+        batcher.stop()
+    assert locksmith.violations() == []
+
+
+def test_observed_edges_match_the_catalog(armed, als_artifacts):
+    """The static<->runtime round-trip: any acquisition edge the sanitizer
+    observed between two locks that BOTH appear in the ARCHITECTURE.md
+    lock-order catalog must match a catalogued row's direction. Edges
+    touching uncatalogued locks are out of scope (the catalog only
+    declares orders for pairs that nest)."""
+    import numpy as np
+
+    from albedo_tpu.analysis.core import default_tree
+    from albedo_tpu.analysis.rules_concurrency import lock_order_catalog
+    from albedo_tpu.serving.batcher import MicroBatcher
+
+    matrix, model = als_artifacts
+    batcher = MicroBatcher(model, window_ms=1.0)
+    try:
+        futs = [batcher.submit(u, 5) for u in range(8)]
+        for f in futs:
+            f.result(timeout=30)
+    finally:
+        batcher.stop()
+
+    catalog = lock_order_catalog(default_tree())
+    assert catalog, "the ARCHITECTURE.md lock-order catalog is missing"
+    names_in_catalog = {n for pair in catalog for n in pair}
+    for outer, inner in locksmith.order_edges():
+        if outer in names_in_catalog and inner in names_in_catalog:
+            assert (outer, inner) in catalog, (
+                f"observed acquisition order {outer} -> {inner} is not a "
+                f"catalogued direction — either catalog it or it inverts "
+                f"a declared row"
+            )
