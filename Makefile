@@ -13,7 +13,7 @@ JOBS = popularity curation content train_als cv_als build_user_profile \
 .PHONY: $(JOBS) test test-all bench serve-bench datacheck-bench chaos \
         chaos-serve chaos-stream chaos-elastic stream stream-bench dryrun \
         soak soak-smoke capacity-bench retrieval-bench lint lint-baseline \
-        sanitize
+        sanitize score score-bench
 
 $(JOBS):
 	$(PY) -m albedo_tpu.cli $@ $(ARGS)
@@ -129,6 +129,20 @@ capacity-bench:
 # p50/p99, achieved GB/s) -> RETRIEVAL_r01.json.
 retrieval-bench:
 	JAX_PLATFORMS=cpu $(PY) bench.py retrieval
+
+# Full-catalog batch scoring: every user through bank MIPS + the LR
+# re-rank, per-shard top-k parquet sealed under a canary-gated manifest
+# (albedo_tpu/scoring/). Preemptible (exit 75 + --resume), elastic
+# (--mesh-devices N remeshes down the ladder on device loss), admission-
+# priced before any byte moves. See README "Batch-scoring runbook".
+score:
+	JAX_PLATFORMS=cpu $(PY) -m albedo_tpu.cli score_all $(ARGS)
+
+# Scoring scenario: sweep throughput (users/s per chip, chip-seconds per
+# million users) plus the 10M-user x 1M-item out-of-core admission pricing
+# (resident vs streamed rung) -> SCORING_r01.json.
+score-bench:
+	JAX_PLATFORMS=cpu $(PY) bench.py scoring
 
 # ALX-scale weak scaling: the fully sharded PIPELINED streamed fit at
 # 1 -> 2 -> 4 -> 8 chips with fixed work per chip (out-of-core synthetic

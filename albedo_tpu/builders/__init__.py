@@ -26,6 +26,7 @@ from albedo_tpu.builders import jobs as _jobs  # noqa: F401  (registers CLI jobs
 from albedo_tpu.builders import pipeline as _pipeline  # noqa: F401  (run_pipeline job)
 from albedo_tpu.streaming import job as _stream_job  # noqa: F401  (run_stream job)
 from albedo_tpu.chaos import soak as _soak_job  # noqa: F401  (chaos soak job)
+from albedo_tpu.scoring import job as _score_job  # noqa: F401  (score_all job)
 
 __all__ = [
     "ALSScorer",

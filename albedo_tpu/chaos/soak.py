@@ -17,8 +17,13 @@ soak run:
    ladder), the **offline pipeline** (ingest -> train_als -> canary publish,
    a real CLI subprocess so kill/term faults genuinely kill something), a
    **serve leg** (validated hot-swap of the published artifact through the
-   real reload gates + live probes), and a **stream leg** (validated delta
-   ingest -> fold-in -> stamped publish);
+   real reload gates + live probes), a **stream leg** (validated delta
+   ingest -> fold-in -> stamped publish), and a **scoring leg** (the
+   ``score_all`` batch sweep under drawn ``score.*`` faults; one pinned
+   cycle per soak — the 2-cycle smoke included — runs it as a real CLI
+   subprocess pair killed mid-spill (``score.spill:kill`` -> exit 137)
+   then resumed, with the sealed manifest checked to cover exactly the
+   scored shards);
 3. checks the standing invariants after every cycle:
 
    - **no unstamped artifact served** — a promoted generation's origin
@@ -112,6 +117,11 @@ MESH_FAULTS = (
     ("als.shard.stream", "error"),
     ("als.shard.prefetch", "error"),
 )
+SCORE_FAULTS = (
+    ("score.shard", "error"),
+    ("score.spill", "ioerror"),
+    ("score.publish", "error"),
+)
 
 # Canonical per-kind evidence placements: where each kind is armed so its
 # firing is OBSERVABLE regardless of what else the cycle draws. The mesh and
@@ -151,7 +161,7 @@ def build_schedule(
         raise ValueError("the soak needs at least 2 cycles for kind coverage")
     rng = random.Random(seed)
     schedule: list[dict] = [
-        {"pipeline": [], "stream": [], "serve": [], "mesh": []}
+        {"pipeline": [], "stream": [], "serve": [], "mesh": [], "score": []}
         for _ in range(cycles)
     ]
     pools = {
@@ -159,10 +169,11 @@ def build_schedule(
         "stream": STREAM_FAULTS,
         "serve": SERVE_FAULTS,
         "mesh": MESH_FAULTS,
+        "score": SCORE_FAULTS,
     }
     for c in range(cycles):
         for leg, pool in pools.items():
-            if rng.random() < (0.6 if leg != "mesh" else 0.3):
+            if rng.random() < (0.6 if leg not in ("mesh", "score") else 0.3):
                 site, kind = rng.choice(pool)
                 schedule[c][leg].append((site, kind, 1))
     kinds = [
@@ -220,6 +231,15 @@ def build_schedule(
             schedule[c]["pipeline"] = [
                 (s, k, a) for s, k, a in legs if k in ("kill", "term")
             ][:1]
+    # The batch-scoring kill cycle: every soak — the 2-cycle smoke included —
+    # pins one `score.spill:kill` on the LAST cycle's scoring leg. The leg
+    # always runs as a real CLI subprocess pair (kill -> --resume), even in
+    # the in-process smoke flavor, so the kill genuinely kills a process; the
+    # resume must walk the cursor, re-score exactly the unsealed shards, and
+    # seal a manifest covering every shard (``check_score_invariants``).
+    # Replacing the whole leg also strips any random raising draw that could
+    # fail the sweep before the armed kill fires.
+    schedule[cycles - 1]["score"] = [("score.spill", "kill", 2)]
     return schedule
 
 
@@ -451,6 +471,108 @@ def _stream_in_process(ctx_factory, args, specs, cycle_seed: int) -> dict:
             rc, err = 1, repr(e)
     return {"job": "run_stream", "rc": rc, "fired": armed.fired,
             "error": err, "faults": [f"{s}:{k}@{a}" for s, k, a in specs]}
+
+
+def _score_in_process(ctx_factory, specs) -> dict:
+    """The scoring leg (non-kill cycles): one in-process ``score_all`` sweep
+    over the soak dataset with the drawn ``score.*`` faults armed. A raising
+    kind must surface as a contract exit code (never a hang or a torn seal);
+    whatever happens, a SEALED manifest must still pass the scoring
+    invariants."""
+    from albedo_tpu.builders.pipeline import PublishRejected
+    from albedo_tpu.parallel.elastic import MeshLost
+    from albedo_tpu.scoring.sweep import (
+        MANIFEST_NAME, check_score_invariants, run_score_all,
+        score_output_root,
+    )
+    from albedo_tpu.utils.capacity import CapacityExceeded
+    from albedo_tpu.utils.checkpoint import Preempted
+
+    ctx = ctx_factory()
+    rc, err = 0, None
+    with _InProcessArm(specs) as armed:
+        try:
+            run_score_all(ctx, shard_users=48, k=10)
+        except PublishRejected as e:
+            rc, err = 4, repr(e)
+        except Preempted as e:
+            rc, err = 75, repr(e)
+        except (MeshLost, CapacityExceeded) as e:
+            rc, err = 1, repr(e)
+        except Exception as e:  # noqa: BLE001 — the CLI would exit 1 too
+            rc, err = 1, repr(e)
+    out_root = score_output_root(ctx.tag)
+    score_violations = (
+        check_score_invariants(out_root)
+        if (out_root / MANIFEST_NAME).exists()
+        else []
+    )
+    return {"job": "score_all", "rc": rc, "fired": armed.fired, "error": err,
+            "score_violations": score_violations,
+            "faults": [f"{s}:{k}@{a}" for s, k, a in specs]}
+
+
+def _export_score_tables(ctx) -> Path:
+    """The smoke flavor's injected in-memory tables, exported once per soak
+    so the scoring kill cycle's SUBPROCESS pair scores the same dataset —
+    and, because both runs pass the same ``--tables`` string, shares one
+    artifact tag between the killed sweep and its resume."""
+    dest = ctx_artifact_dir() / "score-tables"
+    if not (dest / "user_info.parquet").exists():
+        dest.mkdir(parents=True, exist_ok=True)
+        t = ctx.tables()
+        for key in ("user_info", "repo_info", "starring", "relation"):
+            getattr(t, key).to_parquet(dest / f"{key}.parquet", index=False)
+    return dest
+
+
+def _score_kill_resume_leg(
+    args, ctx_factory, specs, timeout: float, injected_tables: bool
+) -> dict:
+    """The pinned ``score.spill:kill`` cycle: a real CLI ``score_all``
+    subprocess is killed mid-spill (exit 137, an unsealed shard on disk),
+    then a second subprocess resumes the cursor and must seal a manifest
+    covering exactly the scored shards. Runs as a subprocess pair in EVERY
+    soak flavor — an in-process kill would take the driver down with it."""
+    from albedo_tpu.scoring.sweep import (
+        MANIFEST_NAME, check_score_invariants, score_output_root,
+    )
+    from albedo_tpu.settings import md5
+
+    base = ["--small", "--score-shard-users", "48", "--score-k", "10"]
+    tables_src = getattr(args, "tables", None)
+    if injected_tables:
+        tables_src = str(_export_score_tables(ctx_factory()))
+    if tables_src:
+        base += ["--tables", str(tables_src)]
+    # The subprocess's dataset identity tag (JobContext's computation): where
+    # on disk the pair's sealed output lands.
+    source = str(tables_src or f"synthetic-{bool(getattr(args, 'small', False))}")
+    tag = md5(source)[:10]
+    kill = _run_cli("score_all", base, specs, timeout)
+    resume = _run_cli("score_all", [*base, "--resume"], [], timeout)
+    out_root = score_output_root(tag)
+    violations: list[str] = []
+    if kill["rc"] != KILL_CODE:
+        violations.append(
+            f"score kill leg exited {kill['rc']}, wanted {KILL_CODE}"
+        )
+    resumed = "resume:" in resume["tail"]
+    if resume["rc"] != 0:
+        violations.append(f"score resume leg exited {resume['rc']}")
+    elif not resumed:
+        violations.append("score resume leg never walked the cursor")
+    if (out_root / MANIFEST_NAME).exists():
+        violations.extend(check_score_invariants(out_root))
+    elif resume["rc"] == 0:
+        violations.append("score resume exited 0 without sealing a manifest")
+    return {
+        "job": "score_all", "rc": resume["rc"], "kill_rc": kill["rc"],
+        "resumed": resumed, "score_violations": violations,
+        "faults": kill["faults"],
+        "wall_s": round(kill["wall_s"] + resume["wall_s"], 1),
+        "tail": resume["tail"][-400:],
+    }
 
 
 def _mesh_leg(specs, ctx_factory=None) -> dict:
@@ -860,6 +982,30 @@ def run_soak(
                 f"the contract {sorted(s_allowed)}"
             )
 
+        score_specs = plan.get("score", [])
+        if any(k == "kill" for _, k, _ in score_specs):
+            score_rec = _score_kill_resume_leg(
+                args, ctx_factory, score_specs, leg_timeout,
+                injected_tables="tables" in (ctx_kwargs or {}),
+            )
+            if score_rec.get("kill_rc") == KILL_CODE:
+                kinds_observed.setdefault(
+                    "kill", f"score_all exit 137 (cycle {c + 1})"
+                )
+        else:
+            score_rec = _score_in_process(ctx_factory, score_specs)
+            observe_in_process(score_rec, score_specs)
+        cycle["legs"].append(score_rec)
+        if score_rec["rc"] not in CONTRACT_CODES:
+            report["violations"].append(
+                f"cycle {c + 1} score exit code {score_rec['rc']} outside "
+                f"the contract {sorted(CONTRACT_CODES)}"
+            )
+        report["violations"].extend(
+            f"cycle {c + 1} score leg: {v}"
+            for v in score_rec.get("score_violations", [])
+        )
+
         cycle["invariant_violations"] = check_invariants(ctx_artifact_dir())
         report["violations"].extend(
             f"cycle {c + 1}: {v}" for v in cycle["invariant_violations"]
@@ -877,7 +1023,9 @@ def run_soak(
         )
     expected_kinds = set(KIND_EVIDENCE)
     if not subprocess_legs:
-        expected_kinds -= {"kill", "term"}
+        # `kill` stays expected: the pinned scoring kill cycle runs as a
+        # real subprocess pair even in the in-process smoke flavor.
+        expected_kinds -= {"term"}
     missing = expected_kinds - set(kinds_observed)
     if missing:
         report["violations"].append(

@@ -47,7 +47,11 @@ def main(argv: list[str] | None = None) -> int:
         "(default: deterministic synthetic tables)",
     )
     parser.add_argument(
-        "--now", type=float, default=None, help="epoch seconds for date features"
+        "--now", type=float, default=None,
+        help="epoch seconds for date features (default: wall clock). "
+        "score_all pins this into its sweep cursor at generation start, and "
+        "--resume restores the pinned instant so resumed shards re-rank "
+        "with the same featurization the sealed shards used",
     )
     parser.add_argument(
         "--data-policy",

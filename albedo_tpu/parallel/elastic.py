@@ -170,6 +170,13 @@ def _run_with_deadline(fn, deadline_s: float, detail: str = ""):
     return result[0]
 
 
+def run_with_deadline(fn, deadline_s: float, detail: str = ""):
+    """Public seam on the collective watchdog for other elastic loops (the
+    ``score_all`` sweep wraps each shard's device work in it): same daemon
+    thread + abandon-on-timeout semantics as the sharded fit's chunks."""
+    return _run_with_deadline(fn, deadline_s, detail)
+
+
 _CHOSEN_TO_MODE = {
     # The elastic driver never runs the replicated GSPMD rung (see module
     # docstring): an ample budget keeps resident sharded tables.

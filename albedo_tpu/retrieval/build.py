@@ -87,8 +87,14 @@ def build_default_bank(
     exclude_table: np.ndarray | None = None,
     mesh=None,
     top_k: int = 30,
+    max_batch: int = 64,
+    item_block: int = 4096,
 ) -> RetrievalBank:
-    bank = RetrievalBank()
+    """``max_batch``/``item_block`` pass through to the bank's blocked-MIPS
+    working-set knobs — the score_all admission ladder sizes them from
+    :func:`albedo_tpu.utils.capacity.plan_score` so the streamed rung is
+    real, not just priced."""
+    bank = RetrievalBank(item_block=item_block, max_batch=max_batch)
     for spec in default_bank_specs(
         model, matrix, starring_df=starring_df,
         content_backend=content_backend, tfidf_search=tfidf_search,

@@ -1,0 +1,35 @@
+"""Full-catalog batch scoring: the preemptible, elastic ``score_all`` job.
+
+The reference system's real production workload is offline: the LR ranker
+precomputes ranked repos for EVERY user, nightly. This package is that
+workload rebuilt as a first-class citizen of the ops machinery — a sweep
+over every user shard through the retrieval bank's blocked MIPS plus the
+blocked LR re-rank, spilling stamped per-shard top-k parquet, with:
+
+- a **checkpointed sweep cursor** (``utils.checkpoint.JsonStepCheckpointer``)
+  so a preempted or killed sweep resumes at the shard boundary;
+- **elastic operation** (``parallel/elastic.py`` semantics): collective
+  deadline, loss classifier, remesh down the ladder, re-admit, resume;
+- a **capacity-admitted** dispatch (``utils.capacity.plan_score`` through
+  ``admit_ladder``): resident -> streamed rungs, refusal before any byte
+  moves;
+- a **canary-gated publish**: probe-slice NDCG@30 against the prior sealed
+  output's ``.meta.json`` stamp before the manifest seals (exit 4 on
+  refusal, prior sealed output untouched).
+
+See ARCHITECTURE.md "Batch scoring" and the README runbook.
+"""
+
+from albedo_tpu.scoring.sweep import (
+    MANIFEST_NAME,
+    check_score_invariants,
+    run_score_all,
+    score_output_root,
+)
+
+__all__ = [
+    "MANIFEST_NAME",
+    "check_score_invariants",
+    "run_score_all",
+    "score_output_root",
+]

@@ -1,0 +1,79 @@
+"""The ``score_all`` CLI job: the full-catalog batch sweep as one command.
+
+Exit-code contract (the repo-wide table in ARCHITECTURE.md):
+
+- 0   sweep complete, canary passed, manifest sealed
+- 1   crash, :class:`MeshLost` (loss budget spent) or capacity refusal
+- 4   canary gate refused the publish (prior sealed output untouched)
+- 75  preempted — the cursor checkpointed; rerun with ``--resume``
+- 137 killed by an armed ``kill`` fault (chaos drills)
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+from albedo_tpu.cli import EXIT_FAILURE, EXIT_REJECTED, register_job
+
+
+@register_job("score_all")
+def score_all_job(args) -> int | None:
+    """Score every user through bank MIPS + the LR re-rank and seal the
+    per-shard top-k parquet under a canary-gated manifest.
+
+    Extra flags: --score-shard-users N (users per shard, default 256),
+    --score-k N (top-k per user, default 30), --score-max-users N (truncate
+    the catalog, 0 = everyone), --canary-floor SCORE (absolute NDCG@30
+    minimum), --canary-tolerance FRAC (max regression vs the prior sealed
+    output's stamp, default 0.10), --publish-force (seal past a failed
+    gate, loudly stamped). Honors the global --resume,
+    --checkpoint-every/--keep-last (cursor retention), --mesh-devices
+    (row-sharded bank + the elastic remesh ladder), --small, --tables.
+    """
+    from albedo_tpu.builders.jobs import JobContext, _report
+    from albedo_tpu.builders.pipeline import PublishRejected
+    from albedo_tpu.parallel.elastic import MeshLost
+    from albedo_tpu.scoring.sweep import run_score_all
+    from albedo_tpu.utils.capacity import CapacityExceeded
+
+    extra = argparse.ArgumentParser()
+    extra.add_argument("--score-shard-users", type=int, default=256)
+    extra.add_argument("--score-k", type=int, default=30)
+    extra.add_argument("--score-max-users", type=int, default=0)
+    extra.add_argument("--canary-floor", type=float, default=0.0)
+    extra.add_argument("--canary-tolerance", type=float, default=None)
+    extra.add_argument("--publish-force", action="store_true")
+    ns, _ = extra.parse_known_args(getattr(args, "_rest", []))
+
+    t0 = time.time()
+    ctx = JobContext(args)
+    try:
+        report = run_score_all(
+            ctx,
+            shard_users=ns.score_shard_users,
+            k=ns.score_k,
+            max_users=ns.score_max_users,
+            canary_floor=ns.canary_floor,
+            canary_tolerance=ns.canary_tolerance,
+            publish_force=ns.publish_force,
+        )
+    except PublishRejected as e:
+        print(f"[score_all] PUBLISH REFUSED by the canary gate: {e} "
+              f"(prior sealed output untouched; --publish-force overrides)")
+        return EXIT_REJECTED
+    except MeshLost as e:
+        print(f"[score_all] MESH LOST: {e} (cursor retained; rerun with "
+              f"--resume on healthy hardware)")
+        return EXIT_FAILURE
+    except CapacityExceeded as e:
+        print(f"[score_all] REFUSED by capacity admission before dispatch: {e}")
+        return EXIT_FAILURE
+    # Preempted propagates: cli.main maps it to exit 75 (--resume continues).
+    print(f"[score_all] generation {report['generation']} sealed: "
+          f"{report['n_shards']} shards, {report['rows']} rows, "
+          f"canary ndcg@30 = {report['canary']['score']}")
+    if report["mesh_events"]["losses"]:
+        print(f"[score_all] mesh events: {report['mesh_events']}")
+    _report("score_all", "users_scored", float(report["users_scored"]), t0)
+    return None

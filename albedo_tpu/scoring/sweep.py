@@ -1,0 +1,595 @@
+"""The score_all sweep engine: cursor, elastic shard loop, spill, publish.
+
+Layout (everything under one artifact-store root, ``<tag>-score_all/``)::
+
+    <tag>-score_all/
+      gen-000002/                     # one staging dir per sweep generation
+        shard_00000.parquet           # per-shard top-k (user_id, repo_id,
+        shard_00001.parquet           #   score=LR probability, source)
+        ...
+      manifest.json                   # sealed LAST: generation + every
+                                      #   shard's file/sha256/user range
+      manifest.json.sha256            # content manifest of the seal
+      manifest.json.meta.json         # canary stamp (publish gate record)
+
+Per-shard spill is tmp + ``os.replace`` with the ``score.spill`` fault site
+between write and rename — a kill mid-spill leaves an unsealed tmp the
+resume walk ignores and re-scores, never a half-written parquet the publish
+trusts. The sweep cursor (which shards are sealed, verified by spill hash
+on resume) checkpoints through
+:class:`~albedo_tpu.utils.checkpoint.JsonStepCheckpointer` after every
+shard, so the cursor is mesh-size independent: a sweep checkpointed at 8
+virtual devices resumes on 2 (the elastic ladder's semantics, shared with
+``parallel/elastic.py``).
+
+Determinism contract: the final per-user top-k is ordered by
+(-probability, repo_id) and candidate scores are exact per item whatever
+the mesh layout (row-sharded tables keep each item's dot product on one
+device), so an interrupted sweep resumed on a different rung spills the
+same rankings an uninterrupted single-device run does — the cross-mesh
+parity drill in ``tests/test_score_cli.py`` holds it to 1e-5. The cursor
+also pins the generation's featurization instant (``ctx.now``): the LR
+re-ranker trains in-process from wall-clock-dated features, so a resume
+restores the original ``now`` rather than re-training a drifted ranker
+against the shards already sealed.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import time
+from pathlib import Path
+
+import numpy as np
+import pandas as pd
+
+from albedo_tpu.utils import events, faults
+from albedo_tpu.utils.jsonio import atomic_write_json, read_json_or_none
+
+# Fault sites (ARCHITECTURE.md "Fault tolerance" catalog): the shard's
+# device work, the spill rename seam, and the publish gate.
+SHARD_FAULT = faults.site("score.shard")
+SPILL_FAULT = faults.site("score.spill")
+PUBLISH_FAULT = faults.site("score.publish")
+
+MANIFEST_NAME = "manifest.json"
+CURSOR_KEY = "scoreCursor"
+_TMP_MARKER = ".albedo-tmp-"
+_MAX_LOSSES = 1  # the elastic loss budget, matching elastic_sharded_fit
+
+
+def score_output_root(tag: str) -> Path:
+    """The sweep's artifact-store root for one dataset tag."""
+    from albedo_tpu.datasets.artifacts import artifact_path
+
+    return artifact_path(f"{tag}-score_all")
+
+
+def _gen_dir(out_root: Path, generation: int) -> Path:
+    return out_root / f"gen-{generation:06d}"
+
+
+def _sweep_tmps(gen_dir: Path) -> int:
+    """Remove spill tmps a killed run left in OUR generation's staging dir
+    (the cursor owns the generation exclusively, so they are always dead)."""
+    if not gen_dir.is_dir():
+        return 0
+    swept = 0
+    for p in gen_dir.iterdir():
+        if _TMP_MARKER in p.name:
+            p.unlink(missing_ok=True)
+            swept += 1
+    return swept
+
+
+def _candidate_frame(bank, raw_ids: np.ndarray, dense: np.ndarray, k: int) -> pd.DataFrame:
+    """One batch's fused candidates as the fusion-ready recommender frame
+    (user_id, repo_id, score, source) — the batched form of
+    ``BankStage.query_frames``: calibrated scores, -1/non-finite slots
+    dropped. Seen items stay IN (the reference's consumers filter
+    downstream; the NDCG probe protocol scores against recent stars)."""
+    out = bank.query(dense, k, raw_user_ids=raw_ids)
+    frames = []
+    for name, (vals, idx) in out.items():
+        spec = bank.specs[name]
+        scale = float(bank.calibration.get(name, {}).get("scale", 1.0))
+        ok = (idx >= 0) & np.isfinite(vals)
+        rows, cols = np.nonzero(ok)
+        if rows.size == 0:
+            continue
+        frames.append(pd.DataFrame({
+            "user_id": raw_ids[rows],
+            "repo_id": spec.item_ids[idx[rows, cols]],
+            "score": vals[rows, cols].astype(np.float64) * scale,
+            "source": name,
+        }))
+    if not frames:
+        return pd.DataFrame({
+            "user_id": pd.Series(dtype=np.int64),
+            "repo_id": pd.Series(dtype=np.int64),
+            "score": pd.Series(dtype=np.float64),
+            "source": pd.Series(dtype=object),
+        })
+    return pd.concat(frames, ignore_index=True)
+
+
+def _score_users(bank, ranker, matrix, dense: np.ndarray, k: int) -> pd.DataFrame:
+    """Candidate generation + LR re-rank for one user batch: the sweep's
+    unit of device work. Cross-source duplicates keep their best
+    probability; the final per-user top-k is ordered by (-probability,
+    repo_id) — a TOTAL order, so the spill is bitwise reproducible across
+    mesh rungs and resume boundaries."""
+    raw = np.asarray(matrix.user_ids)[dense]
+    candidates = _candidate_frame(bank, raw, dense, k)
+    if not len(candidates):
+        return candidates
+    scored = ranker.score(candidates)
+    scored = scored.sort_values(
+        ["user_id", "probability", "repo_id"],
+        ascending=[True, False, True], kind="mergesort",
+    ).drop_duplicates(["user_id", "repo_id"], keep="first")
+    top = scored.groupby("user_id", sort=False).head(k)
+    return pd.DataFrame({
+        "user_id": top["user_id"].to_numpy(np.int64),
+        "repo_id": top["repo_id"].to_numpy(np.int64),
+        "score": top["probability"].to_numpy(np.float64),
+        "source": top["source"].to_numpy(object),
+    })
+
+
+def _spill_shard(gen_dir: Path, idx: int, frame: pd.DataFrame,
+                 start: int, stop: int) -> dict:
+    """Seal one shard's top-k parquet: tmp write -> fault seam -> rename.
+    A kill at the seam leaves only a tmp (swept on resume); the cursor
+    records the sealed file's hash so resume can tell a good spill from a
+    torn or corrupted one."""
+    from albedo_tpu.datasets.artifacts import file_sha256
+
+    gen_dir.mkdir(parents=True, exist_ok=True)
+    name = f"shard_{idx:05d}.parquet"
+    path = gen_dir / name
+    tmp = gen_dir / f"{name}{_TMP_MARKER}{os.getpid()}"
+    frame.to_parquet(tmp, index=False)
+    SPILL_FAULT.hit(path=tmp)
+    os.replace(tmp, path)
+    return {
+        "file": name,
+        "sha256": file_sha256(path),
+        "rows": int(len(frame)),
+        "start": int(start),
+        "stop": int(stop),
+    }
+
+
+def _bank_specs(ctx):
+    """The bank's source specs from this context's trained artifacts — the
+    ``_context_bank`` recipe WITHOUT the build, so capacity admission can
+    price the tables before a single byte moves to device."""
+    from albedo_tpu.recommenders import EmbeddingSearchBackend
+    from albedo_tpu.recommenders.tfidf import TfidfSimilaritySearch
+    from albedo_tpu.retrieval.build import default_bank_specs
+
+    tables = ctx.tables()
+    backend = EmbeddingSearchBackend(tables.repo_info, ctx.word2vec())
+    search = TfidfSimilaritySearch(min_df=2).fit(tables.repo_info)
+    return default_bank_specs(
+        ctx.als_model(), ctx.matrix(), starring_df=tables.starring,
+        content_backend=backend, tfidf_search=search, top_k=30,
+    )
+
+
+def _admit_score(table_shapes, shard_users: int, k: int, n_devices: int):
+    """The resident -> streamed admission ladder for one sweep config.
+    Returns the verdict; a refusal raises
+    :class:`~albedo_tpu.utils.capacity.CapacityExceeded` HERE — before the
+    bank is built, before any shard is read."""
+    from albedo_tpu.utils import capacity
+
+    plans = [
+        capacity.plan_score(
+            table_shapes, shard_users=shard_users, k=k,
+            max_batch=shard_users, n_devices=n_devices,
+        ),
+        capacity.plan_score(
+            table_shapes, shard_users=shard_users, k=k,
+            max_batch=64, n_devices=n_devices, streamed=True,
+        ),
+    ]
+    verdict = capacity.admit_ladder(plans)
+    if verdict.verdict == "refuse":
+        raise capacity.CapacityExceeded(verdict)
+    return verdict
+
+
+def _verify_completed(cursor_doc: dict, gen_dir: Path) -> tuple[dict, list[int]]:
+    """Split a restored cursor's completed shards into (still-good, dropped):
+    a spill whose file is missing or fails its recorded hash is dropped for
+    re-scoring — resume trusts hashes, never mtimes or mere existence."""
+    from albedo_tpu.datasets.artifacts import file_sha256
+
+    good: dict = {}
+    dropped: list[int] = []
+    for key, rec in (cursor_doc.get("completed") or {}).items():
+        path = gen_dir / rec["file"]
+        try:
+            ok = path.is_file() and file_sha256(path) == rec["sha256"]
+        except OSError:
+            ok = False
+        if ok:
+            good[key] = rec
+        else:
+            dropped.append(int(key))
+    return good, sorted(dropped)
+
+
+def check_score_invariants(out_root: Path) -> list[str]:
+    """Post-run invariants for the chaos soak's scoring leg: the sealed
+    manifest must exist, verify, cover exactly its generation's scored
+    shards (contiguous user ranges, no gaps, no extras), and every listed
+    spill must match its recorded hash."""
+    from albedo_tpu.datasets.artifacts import file_sha256, verify_manifest
+
+    out_root = Path(out_root)
+    manifest_path = out_root / MANIFEST_NAME
+    doc = read_json_or_none(manifest_path)
+    if not isinstance(doc, dict):
+        return [f"score: no sealed manifest at {manifest_path}"]
+    violations = []
+    if verify_manifest(manifest_path) is False:
+        violations.append("score: sealed manifest fails its content manifest")
+    gen_dir = _gen_dir(out_root, int(doc.get("generation", 0)))
+    shards = doc.get("shards") or {}
+    n_shards = int(doc.get("n_shards", len(shards)))
+    if sorted(int(i) for i in shards) != list(range(n_shards)):
+        violations.append(
+            f"score: manifest covers shards {sorted(shards)} != 0..{n_shards - 1}"
+        )
+    expect_start = 0
+    for i in range(n_shards):
+        rec = shards.get(str(i))
+        if rec is None:
+            continue
+        if int(rec["start"]) != expect_start:
+            violations.append(
+                f"score: shard {i} starts at {rec['start']}, expected {expect_start}"
+            )
+        expect_start = int(rec["stop"])
+        path = gen_dir / rec["file"]
+        try:
+            ok = path.is_file() and file_sha256(path) == rec["sha256"]
+        except OSError:
+            ok = False
+        if not ok:
+            violations.append(f"score: spill {rec['file']} missing or hash mismatch")
+    if n_shards and expect_start != int(doc.get("n_users", expect_start)):
+        violations.append(
+            f"score: shards cover {expect_start} users, manifest says "
+            f"{doc.get('n_users')}"
+        )
+    return violations
+
+
+def run_score_all(
+    ctx,
+    *,
+    shard_users: int = 256,
+    k: int = 30,
+    max_users: int = 0,
+    canary_floor: float = 0.0,
+    canary_tolerance: float | None = None,
+    publish_force: bool = False,
+) -> dict:
+    """Drive the full sweep: admit -> build bank -> elastic shard loop ->
+    canary-gated publish. Returns the run report dict.
+
+    Raises :class:`~albedo_tpu.utils.capacity.CapacityExceeded` (refused
+    before any byte moved), :class:`~albedo_tpu.utils.checkpoint.Preempted`
+    (cursor checkpointed, exit 75), :class:`~albedo_tpu.parallel.elastic.
+    MeshLost` (loss budget spent, journal status ``mesh_lost``), and
+    :class:`~albedo_tpu.builders.pipeline.PublishRejected` (canary gate,
+    exit 4, prior sealed output untouched).
+    """
+    from albedo_tpu.builders.pipeline import CANARY_TOLERANCE, PublishRejected
+    from albedo_tpu.datasets import artifacts as store
+    from albedo_tpu.parallel.elastic import (
+        MeshLost,
+        collective_deadline_s,
+        run_with_deadline,
+    )
+    from albedo_tpu.parallel.mesh import ITEM_AXIS, make_mesh, next_ladder_rung
+    from albedo_tpu.retrieval.bank import RetrievalBank
+    from albedo_tpu.settings import get_settings
+    from albedo_tpu.utils.checkpoint import (
+        JsonStepCheckpointer,
+        Preempted,
+        PreemptionHandler,
+    )
+    from albedo_tpu.utils.retry import is_collective_lost
+
+    t0 = time.time()
+    matrix = ctx.matrix()
+    n_users = int(matrix.n_users)
+    if max_users and max_users > 0:
+        n_users = min(n_users, int(max_users))
+    shard_users = max(1, int(shard_users))
+    n_shards = -(-n_users // shard_users)
+
+    # Mesh for the bank's row-sharded layout (the item axis carries the
+    # tables; ``parallel/topk.py`` serves per-shard top-k).
+    n_req = max(1, int(getattr(ctx.args, "mesh_devices", 0) or 0))
+    # Always the SHARDED query path, even on one device: the fused and
+    # sharded programs round/tie-break top-k boundaries differently, and
+    # cross-mesh resume parity (kill at N devices, resume at N/2) demands
+    # one layout-invariant scorer. Item-sharding never splits a single
+    # item's dot-product reduction, so scores match bitwise across rungs.
+    bank_mesh = make_mesh(n_req, data=1, item=n_req)
+    n_dev = int(bank_mesh.shape[ITEM_AXIS])
+
+    # --- admission: price the sweep before any byte moves -----------------
+    specs = _bank_specs(ctx)
+    table_shapes = [
+        shape
+        for s in specs
+        for shape in (
+            [s.vectors.shape]
+            + ([s.user_vectors.shape] if s.user_vectors is not None else [])
+        )
+    ]
+    verdict = _admit_score(table_shapes, shard_users, k, n_dev)
+    # The chosen rung is REAL, not just priced: the streamed rung bounds the
+    # bank's in-flight batch at its own max_batch.
+    bank_batch = shard_users if verdict.chosen in ("score", "") else 64
+    print(f"[score_all] admission: {verdict.verdict} -> "
+          f"{verdict.chosen or verdict.workload} "
+          f"({verdict.required_bytes:,} bytes / {verdict.budget_bytes:,} budget)")
+
+    def build_bank(mesh):
+        bank = RetrievalBank(max_batch=bank_batch)
+        for spec in specs:
+            bank.register(spec)
+        bank.build(matrix=matrix, mesh=mesh)
+        return bank
+
+    bank = build_bank(bank_mesh)
+
+    # --- cursor + staging --------------------------------------------------
+    _, resume, keep_last = ctx.checkpoint_opts()
+    ckdir = get_settings().checkpoint_dir / ctx.artifact_name(CURSOR_KEY)
+    out_root = score_output_root(ctx.tag)
+    out_root.mkdir(parents=True, exist_ok=True)
+    sealed = read_json_or_none(out_root / MANIFEST_NAME)
+    sealed_gen = int(sealed.get("generation", 0)) if isinstance(sealed, dict) else 0
+
+    params = {
+        "tag": ctx.tag, "shard_users": shard_users, "k": int(k),
+        "n_users": n_users, "n_shards": n_shards,
+    }
+    cursor = JsonStepCheckpointer(ckdir, keep_last=keep_last)
+    completed: dict = {}
+    rescore: set[int] = set()
+    generation = sealed_gen + 1
+    if resume:
+        restored = cursor.restore_latest()
+        if restored is not None and restored[1].get("params") == params:
+            doc = restored[1]
+            generation = int(doc.get("generation", generation))
+            # Restore the generation's featurization instant: the ranker
+            # trains in-process from ``ctx.now``-dated features, so a resume
+            # at a later wall clock would re-rank with a slightly different
+            # LR than the shards already sealed. The cursor pins ``now`` at
+            # generation start; shards scored before and after a kill share
+            # one scoring function (the cross-mesh parity contract).
+            pinned_now = doc.get("now")
+            if pinned_now is not None and float(pinned_now) != float(ctx.now):
+                ctx.now = float(pinned_now)
+                for cache_key in ("profiles", "ranker", "ranker_auc"):
+                    ctx._cache.pop(cache_key, None)
+            completed, dropped = _verify_completed(doc, _gen_dir(out_root, generation))
+            rescore = set(dropped)
+            for _ in completed:
+                events.score_shards.inc(outcome="skipped")
+            print(f"[score_all] resume: {len(completed)}/{n_shards} shards "
+                  f"sealed, {len(dropped)} dropped for re-score "
+                  f"(generation {generation})")
+        elif restored is not None:
+            print("[score_all] resume: cursor params mismatch — starting a "
+                  "fresh sweep generation")
+    if not completed:
+        # Fresh sweep (or nothing resumable): a stale cursor or unsealed
+        # staging must not be silently adopted. The SEALED generation and
+        # its manifest stay untouched.
+        if not resume and ckdir.exists():
+            shutil.rmtree(ckdir)
+            cursor = JsonStepCheckpointer(ckdir, keep_last=keep_last)
+        for p in out_root.glob("gen-*"):
+            if p.is_dir() and p != _gen_dir(out_root, sealed_gen):
+                shutil.rmtree(p, ignore_errors=True)
+    gen_dir = _gen_dir(out_root, generation)
+    _sweep_tmps(gen_dir)
+    ranker = ctx.ranker_model()  # AFTER the cursor restore pins ctx.now
+
+    deadline = collective_deadline_s()
+    mesh_events = {
+        "n_shards_start": n_dev, "losses": 0, "resumes": 0, "remeshes": [],
+    }
+    losses = 0
+    resume_pending = False
+    users_scored = 0
+    cursor.write_journal("running", len(completed), n_shards,
+                         extra={"generation": generation})
+
+    def save_cursor() -> None:
+        step = (cursor.latest_step() or 0) + 1
+        cursor.save(step, {
+            "format": "score-cursor-v1",
+            "generation": generation,
+            "params": params,
+            "now": float(ctx.now),
+            "completed": completed,
+        })
+
+    # --- the elastic shard loop -------------------------------------------
+    with PreemptionHandler() as preemption:
+        for shard_idx in range(n_shards):
+            key = str(shard_idx)
+            if key in completed:
+                continue
+            if preemption.should_stop():
+                cursor.write_journal("preempted", len(completed), n_shards,
+                                     extra={"generation": generation})
+                raise Preempted(len(completed), ckdir)
+            start = shard_idx * shard_users
+            stop = min(start + shard_users, n_users)
+            dense = np.arange(start, stop, dtype=np.int64)
+
+            while True:
+                def shard_work(dense=dense, shard_idx=shard_idx):
+                    SHARD_FAULT.hit(path=f"shard_{shard_idx:05d}")
+                    return _score_users(bank, ranker, matrix, dense, k)
+
+                try:
+                    frame = run_with_deadline(
+                        shard_work, deadline, detail=f"score shard {shard_idx}"
+                    )
+                except Exception as e:  # noqa: BLE001 — classify, then decide
+                    if not is_collective_lost(e):
+                        raise
+                    # A shard of the mesh is gone (or the injected drill
+                    # says so): count it, spend the loss budget, remesh
+                    # down the ladder, re-admit, re-lay the bank, retry.
+                    events.mesh_losses.inc()
+                    losses += 1
+                    mesh_events["losses"] = losses
+                    step = len(completed)
+                    if losses > _MAX_LOSSES:
+                        cursor.write_journal(
+                            "mesh_lost", step, n_shards,
+                            extra={"generation": generation, "cause": repr(e)},
+                        )
+                        events.elastic_resumes.inc(outcome="failed")
+                        raise MeshLost(step, e, ckdir) from e
+                    rung = next_ladder_rung(n_dev)
+                    if rung is None:
+                        cursor.write_journal(
+                            "mesh_lost", step, n_shards,
+                            extra={"generation": generation, "cause": repr(e)},
+                        )
+                        events.elastic_resumes.inc(outcome="failed")
+                        raise MeshLost(step, e, ckdir) from e
+                    try:
+                        _admit_score(table_shapes, shard_users, k, rung)
+                        new_mesh = make_mesh(rung, data=1, item=rung)
+                        bank = build_bank(new_mesh)
+                    except Exception as rebuild_err:  # noqa: BLE001
+                        cursor.write_journal(
+                            "mesh_lost", step, n_shards,
+                            extra={"generation": generation,
+                                   "cause": repr(rebuild_err)},
+                        )
+                        events.elastic_resumes.inc(outcome="failed")
+                        raise MeshLost(step, rebuild_err, ckdir) from rebuild_err
+                    mesh_events["remeshes"].append({"from": n_dev, "to": rung})
+                    n_dev = rung
+                    resume_pending = True
+                    print(f"[score_all] shard loss at shard {shard_idx}: "
+                          f"remeshed to {rung} device(s), resuming")
+                    continue
+                break
+
+            record = _spill_shard(gen_dir, shard_idx, frame, start, stop)
+            completed[key] = record
+            save_cursor()
+            outcome = "rescored" if shard_idx in rescore else "scored"
+            events.score_shards.inc(outcome=outcome)
+            events.score_users.inc(stop - start)
+            users_scored += stop - start
+            if resume_pending:
+                events.elastic_resumes.inc(outcome="resumed")
+                mesh_events["resumes"] += 1
+                resume_pending = False
+            cursor.write_journal("running", len(completed), n_shards,
+                                 extra={"generation": generation})
+
+    # --- canary-gated publish ---------------------------------------------
+    probe_dense = ctx.test_user_dense(150)
+    probe_dense = probe_dense[probe_dense < n_users]
+    probe = _score_users(bank, ranker, matrix, probe_dense.astype(np.int64), k)
+    score = float(ctx.evaluate_topk(probe)) if len(probe) else 0.0
+    PUBLISH_FAULT.hit()
+
+    tolerance = CANARY_TOLERANCE if canary_tolerance is None else float(canary_tolerance)
+    prior_meta = store.read_meta(out_root / MANIFEST_NAME)
+    baseline = None
+    if isinstance(prior_meta, dict):
+        baseline = (prior_meta.get("canary") or {}).get("score")
+    failures = []
+    if score < float(canary_floor or 0.0):
+        failures.append(
+            f"score {score:.5f} below --canary-floor {float(canary_floor):.5f}"
+        )
+    if baseline is not None and score < float(baseline) * (1.0 - tolerance):
+        failures.append(
+            f"score {score:.5f} regressed more than {tolerance:.0%} below "
+            f"the prior sealed output's {float(baseline):.5f}"
+        )
+    canary = {
+        "metric": "ndcg@30",
+        "score": round(score, 6),
+        "baseline": None if baseline is None else round(float(baseline), 6),
+        "passed": not failures,
+        "forced": bool(publish_force and failures),
+    }
+    if failures:
+        if not publish_force:
+            # Counted only on an actual refusal; the PRIOR sealed manifest
+            # (and its generation dir) is untouched — the new generation's
+            # spills stay unsealed staging a rerun may reuse or wipe.
+            events.score_publish_rejected.inc(gate="canary")
+            cursor.write_journal("complete", len(completed), n_shards,
+                                 extra={"generation": generation,
+                                        "publish": "rejected"})
+            raise PublishRejected("; ".join(failures), score=score,
+                                  baseline=baseline)
+        print(f"[score_all] !!! CANARY GATE OVERRIDDEN (--publish-force): "
+              f"{'; '.join(failures)} — sealing anyway")
+
+    manifest = {
+        "format": "score-all-v1",
+        "generation": generation,
+        "n_users": n_users,
+        "n_shards": n_shards,
+        "shards": completed,
+        **params,
+        "rows": int(sum(r["rows"] for r in completed.values())),
+        "created_at": time.time(),
+    }
+    manifest_path = out_root / MANIFEST_NAME
+    atomic_write_json(manifest_path, manifest, indent=2)
+    store.write_manifest(manifest_path)
+    store.write_meta(manifest_path, {
+        "canary": canary,
+        "params": params,
+        "lineage": {
+            "als_artifact": ctx.als_artifact_name(),
+            "bank_version": bank.version,
+            "tag": ctx.tag,
+        },
+    })
+    # The seal supersedes every older generation: prune their staging dirs.
+    for p in out_root.glob("gen-*"):
+        if p.is_dir() and p != gen_dir:
+            shutil.rmtree(p, ignore_errors=True)
+    cursor.write_journal("complete", n_shards, n_shards,
+                         extra={"generation": generation})
+    return {
+        "generation": generation,
+        "n_users": n_users,
+        "n_shards": n_shards,
+        "users_scored": users_scored,
+        "rows": manifest["rows"],
+        "canary": canary,
+        "admission": verdict.to_dict(),
+        "mesh_events": mesh_events,
+        "wall_s": time.time() - t0,
+    }

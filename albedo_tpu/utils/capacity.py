@@ -611,6 +611,54 @@ def plan_retrieval(
     )
 
 
+def plan_score(
+    tables: "list[tuple[int, int]]",
+    shard_users: int,
+    k: int = 30,
+    max_batch: int = 64,
+    item_block: int = 4096,
+    n_devices: int = 1,
+    streamed: bool = False,
+) -> CapacityPlan:
+    """Price one batch-scoring sweep configuration, PER DEVICE.
+
+    The ``score_all`` job streams user shards through the retrieval bank's
+    blocked MIPS and the LR re-rank; its admission ladder has two rungs
+    built from this model:
+
+    - **resident** (``streamed=False``): the whole user shard is one query
+      batch — the bank sees ``B = shard_users`` and the blocked-MIPS
+      working set scales with it. Fastest when it fits.
+    - **streamed** (``streamed=True``): the bank's internal ``max_batch``
+      splitting bounds the in-flight batch at ``B = max_batch``; only the
+      per-shard top-k landing buffer still scales with the shard. The
+      cheap rung for out-of-core catalogs.
+
+    ``tables`` lists every (rows, dim) table the bank pins (source item
+    tables + their user query tables), row-sharded over ``n_devices``
+    like :func:`plan_retrieval` — a batch job holds ONE generation (no
+    hot-swap pressure). Refusal of BOTH rungs is the "before any byte
+    moves" contract: :class:`CapacityExceeded` fires at admission, before
+    the bank is built or a single shard is read.
+    """
+    n = max(1, int(n_devices))
+    resident = sum(_shard_pad(int(rows), n) * int(d) * 4 // n for rows, d in tables)
+    max_dim = max((int(d) for _, d in tables), default=0)
+    b = max(1, int(max_batch) if streamed else int(shard_users))
+    transient = b * max_dim * 4 + b * (int(item_block) + int(k)) * 4
+    # Per-shard top-k landing buffer (scores f32 + rows i32), resident for
+    # the shard's lifetime on whichever rung — it is what the spill writes.
+    landing = max(1, int(shard_users)) * int(k) * (4 + 4)
+    return CapacityPlan(
+        workload="score_streamed" if streamed else "score",
+        items={
+            "bank_tables": resident,
+            "transient_query": transient,
+            "topk_landing": landing,
+        },
+    )
+
+
 def max_foldin_entries(
     rank: int, n_items: int, budget: int | None = None, length: int = 1
 ) -> int:

@@ -424,6 +424,64 @@ class ShardedStepCheckpointer(StepCheckpointer):
         return super().restore_latest()
 
 
+class JsonStepCheckpointer(StepCheckpointer):
+    """Step checkpoints whose payload is a plain JSON document.
+
+    The batch-scoring sweep cursor (``albedo_tpu/scoring``) checkpoints a
+    small host-side record — which user shards have sealed spill files —
+    not device arrays, so an Orbax pytree step would be pure overhead.
+    This variant keeps every piece of the :class:`StepCheckpointer`
+    discipline (``step_<8 digits>`` dirs, ``.sha256`` sidecar manifests,
+    the backward restore walk over readable steps, ``keep_last``
+    retention, the journal) and swaps the payload format: one
+    ``state.json`` per step, written atomically, manifest-hashed like the
+    sharded layout (the digest covers the whole step because the step IS
+    the one document). The cursor is therefore mesh-size independent by
+    construction — a sweep checkpointed at 8 devices resumes on any rung.
+    """
+
+    DOC_NAME = "state.json"
+
+    def save(self, step: int, tree: Any) -> Path:  # type: ignore[override]
+        step_dir = self._step_dir(step)
+        step_dir.mkdir(parents=True, exist_ok=True)
+        doc_path = atomic_write_json(step_dir / self.DOC_NAME, tree)
+        # Chaos hook parity with the Orbax path: 'corrupt' flips a byte of
+        # the sealed document; 'kill' preempts between the write and its
+        # manifest — both must be survivable by restore_latest's walk.
+        _SAVE_FAULT.hit(path=doc_path)
+        from albedo_tpu.datasets.artifacts import file_sha256
+
+        atomic_write_json(
+            self._manifest_path(step),
+            {"sha256": file_sha256(doc_path), "step": step},
+        )
+        if self.keep_last is not None:
+            self.prune(self.keep_last)
+        return step_dir
+
+    def verify(self, step: int) -> bool:
+        manifest = read_json_or_none(self._manifest_path(step))
+        if manifest is None:
+            return True
+        from albedo_tpu.datasets.artifacts import file_sha256
+
+        try:
+            return manifest.get("sha256") == file_sha256(
+                self._step_dir(step) / self.DOC_NAME
+            )
+        except OSError:
+            return False
+
+    def restore(self, step: int) -> Any:
+        step_dir = self._step_dir(step)
+        _RESTORE_FAULT.hit(path=step_dir)
+        doc = read_json_or_none(step_dir / self.DOC_NAME)
+        if doc is None:
+            raise ValueError(f"{step_dir.name}: no readable {self.DOC_NAME}")
+        return doc
+
+
 def checkpointed_als_fit(
     als,
     matrix,

@@ -233,6 +233,11 @@ RETRIEVAL_PROMOTIONS_TOTAL = "albedo_retrieval_promotions_total"
 # Concurrency sanitizer (analysis/locksmith.py, ALBEDO_LOCKCHECK=1).
 LOCKCHECK_VIOLATIONS_TOTAL = "albedo_lockcheck_violations_total"
 
+# Full-catalog batch scoring (ROADMAP item 4, the score_all job).
+SCORE_USERS_TOTAL = "albedo_score_users_total"
+SCORE_SHARDS_TOTAL = "albedo_score_shards_total"
+SCORE_PUBLISH_REJECTED_TOTAL = "albedo_score_publish_rejected_total"
+
 METRIC_NAMES: frozenset = frozenset(
     v for k, v in list(globals().items())
     if k.isupper() and isinstance(v, str) and v.startswith("albedo_")
@@ -406,4 +411,24 @@ lockcheck_violations = global_counter(
     "Lock-order / unguarded-shared-state violations observed by the "
     "ALBEDO_LOCKCHECK sanitizer, by kind (order/self-deadlock/unguarded).",
     ("kind",),
+)
+# The batch-scoring plane (ROADMAP item 4): the score_all sweep's progress
+# and its canary-gated publish refusals.
+score_users = global_counter(
+    SCORE_USERS_TOTAL,
+    "User rows scored and spilled by the score_all batch sweep.",
+)
+score_shards = global_counter(
+    SCORE_SHARDS_TOTAL,
+    "User shards processed by the score_all sweep cursor, by outcome "
+    "(scored = freshly scored + sealed; skipped = completed in a prior "
+    "run and verified on resume; rescored = a prior spill failed its "
+    "checksum and was scored again).",
+    ("outcome",),
+)
+score_publish_rejected = global_counter(
+    SCORE_PUBLISH_REJECTED_TOTAL,
+    "score_all output manifests refused sealing, by gate (canary = the "
+    "probe-slice NDCG@30 floor/regression gate).",
+    ("gate",),
 )

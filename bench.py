@@ -100,6 +100,28 @@ def error_record(stage: str, error: str, **extra) -> dict:
     }
 
 
+def hardware_fields() -> dict:
+    """Hardware provenance stamped on every SCENARIO record (never the error
+    record, whose shape is pinned by the failure contract): which backend and
+    chip produced the number, and whether the "devices" are host-core
+    virtualizations (``--xla_force_host_platform_device_count``).
+    ``virtual_devices`` is the forced device count on a CPU backend, 0 on
+    real hardware — time-series consumers must never compare a
+    virtual-device figure against a real-chip one."""
+    import jax
+
+    ds = jax.devices()
+    backend = jax.default_backend()
+    forced = "xla_force_host_platform_device_count" in os.environ.get(
+        "XLA_FLAGS", ""
+    )
+    return {
+        "backend": backend,
+        "device_kind": getattr(ds[0], "device_kind", "?"),
+        "virtual_devices": len(ds) if (forced and backend == "cpu") else 0,
+    }
+
+
 def fail(stage: str, error: str, **extra) -> None:
     """Emit the single structured JSON error line and exit nonzero."""
     print(json.dumps(error_record(stage, error, **extra)), flush=True)
@@ -659,6 +681,7 @@ def ranker_bench() -> dict:
     compile_total = float(lr_model.compile_s or 0.0)
     return {
         "metric": "ranker_train_wallclock",
+        **hardware_fields(),
         "value": round(train_s, 3),
         "unit": "s",
         "vs_baseline": round(train_s / BASELINE_RANKER_TRAIN_S, 5),
@@ -738,6 +761,7 @@ def w2v_refscale_bench() -> dict:
     train_s = _time.perf_counter() - t0
     return {
         "metric": "w2v_train_wallclock_refscale",
+        **hardware_fields(),
         "value": round(train_s, 3),
         "unit": "s",
         "vs_baseline": round(train_s / BASELINE_W2V_TRAIN_S, 5),
@@ -978,6 +1002,7 @@ def als_record(train_s, ndcg, info, flop, mfu, peak_source,
     achieved_gbps = bytes_per_iter * n_iters / max(train_s, 1e-9) / 1e9
     return {
         "metric": "als_train_wallclock_rank50_iter26",
+        **hardware_fields(),
         "value": round(train_s, 3),
         "unit": "s",
         "vs_baseline": round(train_s / BASELINE_ALS_TRAIN_S, 5),
@@ -1133,6 +1158,7 @@ def serving_bench() -> dict:
 
     record: dict = {
         "metric": "serving_throughput_concurrent",
+        **hardware_fields(),
         "unit": "req/s",
         "concurrency": concurrency,
         "duration_s": duration_s,
@@ -1330,6 +1356,7 @@ def datacheck_bench() -> dict:
     overhead = (validated - base) / max(base, 1e-9)
     return {
         "metric": "datacheck_overhead_frac",
+        **hardware_fields(),
         "unit": "fraction of ingest wall-clock",
         "value": round(overhead, 4),
         "within_budget": bool(overhead <= budget_frac),
@@ -1438,6 +1465,7 @@ def foldin_bench() -> dict:
     cycle_s = med("cycle_s")
     return {
         "metric": "foldin_batch_latency_s",
+        **hardware_fields(),
         "unit": "seconds per touched-user fold-in batch (median)",
         "value": round(foldin_batch_s, 5),
         "cycle_s_median": round(cycle_s, 4),
@@ -1651,6 +1679,7 @@ def retrieval_bench() -> dict:
     )
     return {
         "metric": "retrieval_candidates_rps",
+        **hardware_fields(),
         "unit": "fused candidate requests/s at c="
                 f"{concurrency} (median of {max(1, trials)} interleaved trials)",
         "value": bnk["rps"],
@@ -1742,6 +1771,7 @@ def capacity_bench() -> dict:
     chunked_s = statistics.median(chk_trials)
     return {
         "metric": "chunked_fallback_overhead",
+        **hardware_fields(),
         "unit": "chunked/resident fit wall-clock ratio",
         "value": round(chunked_s / max(resident_s, 1e-9), 3),
         "resident_fit_s_median": round(resident_s, 4),
@@ -2119,12 +2149,119 @@ def scale_bench() -> dict:
         "rank": rank,
         "users_per_chip": users_per_chip,
         "mean_stars": mean_stars,
-        "backend": jax.default_backend(),
-        "device_kind": getattr(jax.devices()[0], "device_kind", "?"),
+        **hardware_fields(),
     }
     out_path = os.environ.get(
         "ALBEDO_SCALE_OUT",
         os.path.join(os.path.dirname(os.path.abspath(__file__)), "MULTICHIP_r07.json"),
+    )
+    try:
+        with open(out_path, "w") as f:
+            json.dump(record, f, indent=2)
+            f.write("\n")
+    except OSError as e:
+        record["record_write_error"] = repr(e)
+    return record
+
+
+def scoring_bench() -> dict:
+    """The `scoring` scenario: full-catalog batch sweep throughput.
+
+    Runs a small in-process ``score_all`` sweep — the REAL job path: bank
+    MIPS candidate generation, the blocked LR re-rank, stamped per-shard
+    parquet spill, canary-gated manifest seal — and reports **users/s per
+    chip** and **chip-seconds per million users** (the capacity-planning
+    figure: how much accelerator time a full-catalog nightly costs). Model
+    prerequisites (ALS, w2v, ranker) are trained OUTSIDE the timed sweep.
+
+    The record then prices the out-of-core 10M-user x 1M-item
+    parameterization through ``plan_score``'s resident -> streamed admission
+    ladder — the refusal/degrade decision the real job would make before
+    any byte moves. Lands in SCORING_r01.json. Env knobs:
+    ALBEDO_SCORING_USERS/ITEMS/SHARD_USERS/K/OUT.
+    """
+    import argparse
+    import time as _time
+
+    from albedo_tpu.builders.jobs import JobContext
+    from albedo_tpu.datasets import synthetic_tables
+    from albedo_tpu.scoring.sweep import run_score_all
+    from albedo_tpu.settings import md5
+    from albedo_tpu.utils.capacity import admit_ladder, plan_score
+
+    n_users = int(os.environ.get("ALBEDO_SCORING_USERS", "600"))
+    n_items = int(os.environ.get("ALBEDO_SCORING_ITEMS", "400"))
+    shard_users = int(os.environ.get("ALBEDO_SCORING_SHARD_USERS", "200"))
+    k = int(os.environ.get("ALBEDO_SCORING_K", "30"))
+
+    tables = synthetic_tables(
+        n_users=n_users, n_items=n_items, mean_stars=12, seed=42
+    )
+    tag = md5(f"bench-scoring-{n_users}-{n_items}-{shard_users}-{k}")[:10]
+    args = argparse.Namespace(
+        small=True, tables=None, now=1700000000.0, no_compilation_cache=False,
+        data_policy=None, solver="cholesky", cg_steps=3, checkpoint_every=0,
+        resume=False, keep_last=2, mesh_devices=0, _rest=[],
+    )
+    ctx = JobContext(args, tables=tables, tag=tag)
+    ctx.ranker_model()  # train prerequisites outside the timed sweep
+    t0 = _time.perf_counter()
+    report = run_score_all(ctx, shard_users=shard_users, k=k)
+    sweep_s = _time.perf_counter() - t0
+
+    n_chips = max(1, int(report["mesh_events"].get("n_shards_start") or 1))
+    users_per_s = report["users_scored"] / max(sweep_s, 1e-9)
+    users_per_s_per_chip = users_per_s / n_chips
+
+    # Out-of-core pricing: the full-catalog parameterization through the
+    # same cost model the job's admission runs. Dims mirror the serving
+    # bank's sources (ALS factors + content + tfidf projections).
+    ooc_users = int(os.environ.get("ALBEDO_SCORING_OOC_USERS", str(10_000_000)))
+    ooc_items = int(os.environ.get("ALBEDO_SCORING_OOC_ITEMS", str(1_000_000)))
+    ooc_tables = [(ooc_items, 50), (ooc_items, 200), (ooc_items, 512)]
+    resident = plan_score(ooc_tables, shard_users=4096, k=k)
+    streamed = plan_score(ooc_tables, shard_users=4096, k=k, streamed=True)
+    verdict = admit_ladder([resident, streamed])
+
+    record = {
+        "metric": "score_all_users_per_s_per_chip",
+        **hardware_fields(),
+        "value": round(users_per_s_per_chip, 2),
+        "unit": "users/s per chip (sweep + spill + canary publish wall-clock)",
+        "chip_seconds_per_million_users": round(
+            1e6 / max(users_per_s_per_chip, 1e-9), 1
+        ),
+        "users_scored": int(report["users_scored"]),
+        "rows_spilled": int(report["rows"]),
+        "n_shards": int(report["n_shards"]),
+        "n_users": n_users,
+        "n_items": n_items,
+        "shard_users": shard_users,
+        "k": k,
+        "n_chips": n_chips,
+        "sweep_wall_s": round(sweep_s, 3),
+        "canary_ndcg30": report["canary"]["score"],
+        "admission": report["admission"],
+        "out_of_core_10m_x_1m": {
+            "n_users": ooc_users,
+            "n_items": ooc_items,
+            "table_dims": [d for _, d in ooc_tables],
+            "resident_bytes": resident.required_bytes,
+            "streamed_bytes": streamed.required_bytes,
+            "verdict": verdict.to_dict(),
+            "est_chip_hours": round(
+                ooc_users / max(users_per_s_per_chip, 1e-9) / 3600.0, 2
+            ),
+        },
+        "scale_note": (
+            "CPU-smoke sized: users/s per chip here prices the path, not a "
+            "real slice; the 10m x 1m block is the analytic admission the "
+            "job would run at catalog scale"
+        ),
+    }
+    out_path = os.environ.get(
+        "ALBEDO_SCORING_OUT",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)), "SCORING_r01.json"),
     )
     try:
         with open(out_path, "w") as f:
@@ -2142,6 +2279,7 @@ SCENARIOS = {
     "capacity": capacity_bench,
     "scale": scale_bench,
     "retrieval": retrieval_bench,
+    "scoring": scoring_bench,
 }
 
 

@@ -107,12 +107,32 @@ def test_w2v_refscale_record_shape(monkeypatch):
     assert rec["value"] > 0 and rec["epoch_tokens_per_s"] > 0
     assert rec["vocab_size"] > 0
     assert "scale_note" in rec and "unpublished" in rec["scale_note"]
+    assert rec["backend"] and rec["device_kind"]
+    assert rec["virtual_devices"] >= 0
 
 
 def test_watchdog_partial_status_field():
     """The watchdog re-emit carries status=partial (ADVICE r4 #1 contract)."""
     record = bench.error_record("x", "y")
     assert "status" not in record  # hard failures carry stage/error instead
+    # The error-record shape is pinned by the failure contract: hardware
+    # provenance is a success-record stamp only.
+    for key in ("backend", "virtual_devices"):
+        assert key not in record
+
+
+def test_hardware_fields_shape(monkeypatch):
+    """Every scenario record carries hardware provenance: backend,
+    device_kind, and the forced-virtual device count (0 on real chips)."""
+    fields = bench.hardware_fields()
+    assert set(fields) == {"backend", "device_kind", "virtual_devices"}
+    assert fields["backend"] and fields["device_kind"]
+    # Under the test harness CPU is forced to 8 virtual devices; either way
+    # the field is a non-negative int, and 0 whenever nothing is forced.
+    assert isinstance(fields["virtual_devices"], int)
+    assert fields["virtual_devices"] >= 0
+    monkeypatch.setenv("XLA_FLAGS", "")
+    assert bench.hardware_fields()["virtual_devices"] == 0
 
 
 @pytest.mark.slow
@@ -135,6 +155,8 @@ def test_retrieval_scenario_record_shape(monkeypatch):
     assert rec["bytes_scanned_per_query"] == sum(
         s["rows"] * s["dim"] * 4 for s in rec["sources"].values()
     )
+    assert rec["backend"] and rec["device_kind"]
+    assert rec["virtual_devices"] >= 0
 
 
 @pytest.mark.slow
@@ -187,3 +209,37 @@ def test_scale_scenario_record_shape(monkeypatch, tmp_path):
     for mode in ("allgather", "ring"):
         assert rec["largest_fittable"][mode]["max_users"] > 0
     assert json.loads(out.read_text())["metric"] == "sharded_als_weak_scaling"
+    assert rec["backend"] and rec["device_kind"]
+    assert rec["virtual_devices"] >= 0
+
+
+@pytest.mark.slow
+def test_scoring_scenario_record_shape(monkeypatch, tmp_path):
+    """Micro-size run of the `scoring` scenario: the record must carry
+    users/s per chip, chip-seconds per million users, the canary score the
+    publish was gated on, and the analytic 10M x 1M out-of-core admission
+    (both rungs' bytes + the ladder verdict), and land in SCORING_r01.json."""
+    out = tmp_path / "SCORING_r01.json"
+    monkeypatch.setenv("ALBEDO_SCORING_USERS", "150")
+    monkeypatch.setenv("ALBEDO_SCORING_ITEMS", "100")
+    monkeypatch.setenv("ALBEDO_SCORING_SHARD_USERS", "64")
+    monkeypatch.setenv("ALBEDO_SCORING_K", "10")
+    monkeypatch.setenv("ALBEDO_SCORING_OUT", str(out))
+    rec = bench.scoring_bench()
+    assert rec["metric"] == "score_all_users_per_s_per_chip"
+    assert rec["value"] > 0
+    assert rec["chip_seconds_per_million_users"] > 0
+    assert rec["users_scored"] > 0 and rec["rows_spilled"] > 0
+    assert rec["n_shards"] >= 2  # shard_users=64 over >=100 matrix users
+    assert 0.0 <= rec["canary_ndcg30"] <= 1.0
+    assert rec["admission"]["workload"].startswith("score")
+    ooc = rec["out_of_core_10m_x_1m"]
+    assert ooc["n_users"] == 10_000_000 and ooc["n_items"] == 1_000_000
+    # The streamed rung trades transient query memory for resident tables:
+    # its footprint must be strictly cheaper than the resident rung's.
+    assert 0 < ooc["streamed_bytes"] < ooc["resident_bytes"]
+    assert ooc["verdict"]["workload"] == "score"
+    assert ooc["est_chip_hours"] > 0
+    assert rec["backend"] and rec["device_kind"]
+    assert rec["virtual_devices"] >= 0
+    assert json.loads(out.read_text())["metric"] == "score_all_users_per_s_per_chip"

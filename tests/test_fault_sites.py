@@ -60,5 +60,6 @@ def test_known_sites_are_present():
         "capacity.admit", "mesh.devices", "als.chunked",
         "als.shard.gather", "als.shard.stream", "als.shard.collective",
         "als.shard.prefetch", "retrieval.build", "retrieval.query",
+        "score.shard", "score.spill", "score.publish",
     ):
         assert site in code, f"expected fault site {site!r} not found in code"

@@ -1,0 +1,288 @@
+"""Batch scoring (``score_all``): the JSON sweep cursor, the capacity cost
+model, and the sweep lifecycle — seal, preempt/resume, corrupt-spill
+re-score, canary refusal, elastic remesh — on tiny in-process tables."""
+
+import argparse
+import json
+
+import numpy as np
+import pandas as pd
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from albedo_tpu.builders.jobs import JobContext  # noqa: E402
+from albedo_tpu.builders.pipeline import PublishRejected  # noqa: E402
+from albedo_tpu.datasets import synthetic_tables  # noqa: E402
+from albedo_tpu.datasets import artifacts as store  # noqa: E402
+from albedo_tpu.parallel.elastic import MeshLost  # noqa: E402
+from albedo_tpu.scoring.sweep import (  # noqa: E402
+    CURSOR_KEY,
+    MANIFEST_NAME,
+    check_score_invariants,
+    run_score_all,
+    score_output_root,
+)
+from albedo_tpu.settings import get_settings  # noqa: E402
+from albedo_tpu.utils import capacity, events, faults  # noqa: E402
+from albedo_tpu.utils.checkpoint import JsonStepCheckpointer, Preempted  # noqa: E402
+
+
+def make_ctx(resume=False, mesh_devices=0):
+    ns = argparse.Namespace(
+        small=True, tables=None, now=1700000000.0, no_compilation_cache=True,
+        data_policy=None, solver="cholesky", cg_steps=3, checkpoint_every=0,
+        resume=resume, keep_last=3, mesh_devices=mesh_devices, _rest=[],
+    )
+    tables = synthetic_tables(n_users=120, n_items=80, mean_stars=10, seed=11)
+    return JobContext(ns, tables=tables, tag="scoretest")
+
+
+def cursor_dir(ctx):
+    return get_settings().checkpoint_dir / ctx.artifact_name(CURSOR_KEY)
+
+
+def test_job_is_registered():
+    import albedo_tpu.builders  # noqa: F401  (registers)
+    from albedo_tpu.cli import _JOBS
+
+    assert "score_all" in _JOBS
+
+
+class TestJsonStepCheckpointer:
+    def test_roundtrip_and_latest(self, tmp_path):
+        ck = JsonStepCheckpointer(tmp_path / "ck", keep_last=3)
+        ck.save(1, {"a": 1})
+        ck.save(2, {"a": 2, "nested": {"b": [1, 2]}})
+        step, doc = ck.restore_latest()
+        assert step == 2
+        assert doc == {"a": 2, "nested": {"b": [1, 2]}}
+
+    def test_keep_last_prunes(self, tmp_path):
+        ck = JsonStepCheckpointer(tmp_path / "ck", keep_last=2)
+        for step in range(1, 6):
+            ck.save(step, {"step": step})
+        assert ck.steps() == [4, 5]
+        # Pruned manifests went with their steps.
+        assert not (tmp_path / "ck" / "step_00000001.sha256").exists()
+
+    def test_corrupt_doc_falls_back_to_previous_step(self, tmp_path):
+        ck = JsonStepCheckpointer(tmp_path / "ck", keep_last=None)
+        ck.save(1, {"good": True})
+        ck.save(2, {"good": False})
+        (tmp_path / "ck" / "step_00000002" / ck.DOC_NAME).write_text("{gar")
+        step, doc = ck.restore_latest()
+        assert (step, doc) == (1, {"good": True})
+        assert events.checkpoint_fallbacks.total() >= 1
+
+    def test_journal_roundtrip(self, tmp_path):
+        ck = JsonStepCheckpointer(tmp_path / "ck")
+        ck.write_journal("running", 1, 3, extra={"generation": 2})
+        doc = ck.read_journal()
+        assert doc["status"] == "running"
+        assert doc["step"] == 1 and doc["max_iter"] == 3
+        assert doc["generation"] == 2
+
+
+class TestPlanScore:
+    TABLES = [(1_000_000, 64), (1_000_000, 200)]
+
+    def test_streamed_rung_is_cheaper(self):
+        resident = capacity.plan_score(self.TABLES, shard_users=4096, k=30,
+                                       max_batch=4096)
+        streamed = capacity.plan_score(self.TABLES, shard_users=4096, k=30,
+                                       max_batch=64, streamed=True)
+        assert streamed.required_bytes < resident.required_bytes
+        assert streamed.workload == "score_streamed"
+        assert resident.workload == "score"
+        # Only the transient query working set shrinks; the bank tables and
+        # the per-shard landing buffer are rung-independent.
+        assert streamed.items["bank_tables"] == resident.items["bank_tables"]
+        assert streamed.items["topk_landing"] == resident.items["topk_landing"]
+        assert streamed.items["transient_query"] < resident.items["transient_query"]
+
+    def test_row_sharding_divides_the_bank_tables(self):
+        one = capacity.plan_score(self.TABLES, shard_users=256, n_devices=1)
+        four = capacity.plan_score(self.TABLES, shard_users=256, n_devices=4)
+        assert four.items["bank_tables"] * 4 == pytest.approx(
+            one.items["bank_tables"], rel=1e-3
+        )
+
+    def test_admission_ladder_verdicts(self):
+        resident = capacity.plan_score(self.TABLES, shard_users=4096,
+                                       max_batch=4096)
+        streamed = capacity.plan_score(self.TABLES, shard_users=4096,
+                                       max_batch=64, streamed=True)
+        fit = capacity.admit_ladder([resident, streamed],
+                                    budget=resident.required_bytes + 1)
+        assert fit.verdict == "fit" and fit.chosen == "score"
+        degrade = capacity.admit_ladder([resident, streamed],
+                                        budget=resident.required_bytes - 1)
+        assert degrade.verdict == "degrade" and degrade.chosen == "score_streamed"
+        refuse = capacity.admit_ladder([resident, streamed], budget=1024)
+        assert refuse.verdict == "refuse" and refuse.chosen == ""
+
+    def test_sweep_refuses_before_any_byte_moves(self, monkeypatch):
+        from albedo_tpu.scoring.sweep import _admit_score
+
+        monkeypatch.setenv("ALBEDO_DEVICE_MEM_BYTES", "64k")
+        with pytest.raises(capacity.CapacityExceeded):
+            _admit_score([(10_000_000, 512)], shard_users=4096, k=30,
+                         n_devices=1)
+
+
+class TestSweepLifecycle:
+    def test_clean_sweep_seals_manifest(self):
+        ctx = make_ctx()
+        report = run_score_all(ctx, shard_users=48, k=10)
+        assert report["generation"] == 1
+        assert report["n_users"] == 120 and report["n_shards"] == 3
+        assert report["users_scored"] == 120
+        assert report["mesh_events"]["losses"] == 0
+        assert report["admission"]["verdict"] in ("fit", "degrade")
+
+        out_root = score_output_root(ctx.tag)
+        doc = json.loads((out_root / MANIFEST_NAME).read_text())
+        assert doc["generation"] == 1 and doc["n_shards"] == 3
+        assert doc["rows"] == sum(r["rows"] for r in doc["shards"].values())
+        assert check_score_invariants(out_root) == []
+
+        # Spills are readable fusion-ready frames: per-user top-k, bounded
+        # at k, users inside the shard's recorded range.
+        for i, rec in sorted(doc["shards"].items(), key=lambda kv: int(kv[0])):
+            frame = pd.read_parquet(out_root / "gen-000001" / rec["file"])
+            assert set(frame.columns) == {"user_id", "repo_id", "score", "source"}
+            assert frame.groupby("user_id").size().max() <= 10
+            dense = ctx.matrix().users_of(frame["user_id"].to_numpy(np.int64))
+            assert (dense >= rec["start"]).all() and (dense < rec["stop"]).all()
+
+        # The canary stamp sealed with the manifest.
+        meta = store.read_meta(out_root / MANIFEST_NAME)
+        assert meta["canary"]["metric"] == "ndcg@30"
+        assert meta["canary"]["passed"] is True
+        assert meta["lineage"]["tag"] == ctx.tag
+
+        # Counters and the cursor journal agree with the report.
+        assert events.score_users.total() == 120
+        assert events.score_shards.value(outcome="scored") == 3
+        journal = JsonStepCheckpointer(cursor_dir(ctx)).read_journal()
+        assert journal["status"] == "complete" and journal["generation"] == 1
+
+    def test_preempt_resume_and_corrupt_spill_rescore(self):
+        ctx = make_ctx()
+        # A polite preemption lands mid-sweep: the 2nd shard's work hits the
+        # armed SIGTERM, that shard still seals, and the loop exits 75-style
+        # at the next boundary with the cursor checkpointed.
+        faults.arm("score.shard", kind="term", at=2)
+        with pytest.raises(Preempted):
+            run_score_all(ctx, shard_users=48, k=10)
+        faults.reset()
+        journal = JsonStepCheckpointer(cursor_dir(ctx)).read_journal()
+        assert journal["status"] == "preempted"
+        out_root = score_output_root(ctx.tag)
+        assert not (out_root / MANIFEST_NAME).exists()
+
+        # Corrupt the first sealed spill: resume must DROP it (hash
+        # mismatch), re-score it, skip the intact shard, and finish.
+        spill = out_root / "gen-000001" / "shard_00000.parquet"
+        spill.write_bytes(spill.read_bytes()[:-3] + b"xxx")
+        scored_before = events.score_shards.value(outcome="scored")
+        # The resume context comes up at a LATER wall clock; the cursor must
+        # restore the generation's pinned featurization instant so the ranker
+        # the remaining shards score with matches the sealed shards'.
+        ctx2 = make_ctx(resume=True)
+        ctx2.now = ctx.now + 86400.0
+        report = run_score_all(ctx2, shard_users=48, k=10)
+        assert ctx2.now == ctx.now
+        assert report["generation"] == 1
+        # Shard 0 re-scored (48 users) + shard 2 freshly scored (24): the
+        # intact shard 1 was skipped without touching the device.
+        assert report["users_scored"] == 72
+        assert events.score_shards.value(outcome="skipped") == 1
+        assert events.score_shards.value(outcome="rescored") == 1
+        assert events.score_shards.value(outcome="scored") == scored_before + 1
+        assert check_score_invariants(out_root) == []
+
+    def test_canary_refusal_leaves_prior_seal_untouched(self):
+        ctx = make_ctx()
+        run_score_all(ctx, shard_users=48, k=10)
+        out_root = score_output_root(ctx.tag)
+        sealed_bytes = (out_root / MANIFEST_NAME).read_bytes()
+
+        # An impossible floor refuses the publish: the PRIOR seal (bytes and
+        # generation dir) is untouched, the refusal is counted, and the new
+        # generation's spills stay unsealed staging.
+        with pytest.raises(PublishRejected):
+            run_score_all(ctx, shard_users=48, k=10, canary_floor=1.1)
+        assert (out_root / MANIFEST_NAME).read_bytes() == sealed_bytes
+        assert (out_root / "gen-000001").is_dir()
+        assert (out_root / "gen-000002").is_dir()  # unsealed staging
+        assert events.score_publish_rejected.value(gate="canary") == 1
+        assert check_score_invariants(out_root) == []  # still the old seal
+
+        # --publish-force seals past the failed gate, loudly stamped.
+        report = run_score_all(ctx, shard_users=48, k=10, canary_floor=1.1,
+                               publish_force=True)
+        assert report["generation"] == 2
+        meta = store.read_meta(out_root / MANIFEST_NAME)
+        assert meta["canary"]["passed"] is False
+        assert meta["canary"]["forced"] is True
+        assert check_score_invariants(out_root) == []
+
+    def test_mesh_loss_remeshes_down_the_ladder(self):
+        ctx = make_ctx(mesh_devices=4)
+        faults.arm("score.shard", kind="loss", at=2)
+        report = run_score_all(ctx, shard_users=48, k=10)
+        assert report["mesh_events"]["n_shards_start"] == 4
+        assert report["mesh_events"]["losses"] == 1
+        assert report["mesh_events"]["remeshes"] == [{"from": 4, "to": 2}]
+        assert report["mesh_events"]["resumes"] == 1
+        assert check_score_invariants(score_output_root(ctx.tag)) == []
+
+        # A second loss spends the budget: the cursor journals mesh_lost and
+        # the sweep surfaces MeshLost (CLI exit 1, --resume continues later).
+        faults.reset()
+        faults.arm("score.shard", kind="loss", at=1, times=2)
+        with pytest.raises(MeshLost):
+            run_score_all(ctx, shard_users=48, k=10)
+        journal = JsonStepCheckpointer(cursor_dir(ctx)).read_journal()
+        assert journal["status"] == "mesh_lost"
+        assert events.elastic_resumes.value(outcome="failed") >= 1
+
+
+class TestInvariantChecker:
+    def test_missing_manifest_is_the_first_violation(self, tmp_path):
+        out = tmp_path / "nothing-here"
+        violations = check_score_invariants(out)
+        assert len(violations) == 1 and "no sealed manifest" in violations[0]
+
+    def _spill(self, gen_dir, name):
+        from albedo_tpu.datasets.artifacts import file_sha256
+
+        gen_dir.mkdir(parents=True, exist_ok=True)
+        frame = pd.DataFrame({"user_id": [1, 2], "repo_id": [3, 4],
+                              "score": [0.5, 0.4], "source": ["als", "als"]})
+        frame.to_parquet(gen_dir / name, index=False)
+        return file_sha256(gen_dir / name)
+
+    def test_gaps_missing_shards_and_bad_hashes_detected(self, tmp_path):
+        out = tmp_path / "score-root"
+        sha = self._spill(out / "gen-000001", "shard_00000.parquet")
+        doc = {
+            "format": "score-all-v1", "generation": 1, "n_users": 10,
+            "n_shards": 2,
+            "shards": {"0": {"file": "shard_00000.parquet", "sha256": sha,
+                             "rows": 2, "start": 0, "stop": 5}},
+        }
+        out.mkdir(exist_ok=True)
+        (out / MANIFEST_NAME).write_text(json.dumps(doc))
+        violations = check_score_invariants(out)
+        assert any("!= 0..1" in v for v in violations)        # shard 1 absent
+        assert any("cover 5 users" in v for v in violations)  # 5 != 10
+
+        doc["n_shards"] = 1
+        doc["n_users"] = 5
+        doc["shards"]["0"]["sha256"] = "0" * 64
+        (out / MANIFEST_NAME).write_text(json.dumps(doc))
+        violations = check_score_invariants(out)
+        assert any("hash mismatch" in v for v in violations)

@@ -48,14 +48,30 @@ class TestSchedule:
         assert kinds >= set(KIND_EVIDENCE)
 
     def test_kill_term_excluded_in_process(self):
+        """In-process legs never arm kill/term (they would kill the soak
+        driver). The SCORE leg is exempt: its pinned kill cycle always runs
+        as a subprocess pair, even in the smoke flavor."""
         schedule = build_schedule(4, seed=1, include_kill_term=False)
         kinds = {
             k
             for cycle in schedule
-            for specs in cycle.values()
+            for leg, specs in cycle.items()
+            if leg != "score"
             for _, k, _ in specs
         }
         assert "kill" not in kinds and "term" not in kinds
+
+    def test_scoring_kill_cycle_always_pinned(self):
+        """Every soak — the 2-cycle smoke included — pins exactly one
+        `score.spill:kill` scoring cycle, carrying ONLY the kill (a raising
+        draw on the same leg could fail the sweep before the kill fires)."""
+        for cycles, seed, inc in ((2, 7, False), (6, 3, True), (10, 42, True)):
+            schedule = build_schedule(cycles, seed, include_kill_term=inc)
+            kill_legs = [
+                c["score"] for c in schedule
+                if any(k == "kill" for _, k, _ in c["score"])
+            ]
+            assert kill_legs == [[("score.spill", "kill", 2)]], (cycles, seed)
 
     def test_canonical_sites_never_double_armed(self):
         """Only the FIRST matching armed spec fires at a hit — the coverage
@@ -125,13 +141,30 @@ def test_soak_smoke(monkeypatch):
     assert report["capacity_drill"]["ok"] is True
     assert report["capacity_drill"]["mode"] == "chunked"
     assert set(report["kinds_observed"]) >= {
-        "error", "ioerror", "corrupt", "delay", "oom", "loss",
+        "error", "ioerror", "corrupt", "delay", "oom", "loss", "kill",
     }
-    # Every leg of every cycle reported an exit code inside the contract.
+    # Every leg of every cycle reported an exit code inside the contract
+    # (the scoring kill leg's 137 lives in its `kill_rc` field; its `rc` is
+    # the RESUME subprocess's).
     for cycle in report["cycles"]:
         for leg in cycle["legs"]:
             assert leg["rc"] in (0, 1, 3, 4, 75), (cycle["cycle"], leg)
         assert cycle["invariant_violations"] == []
+    # The scoring leg ran every cycle, and the pinned kill cycle's
+    # subprocess pair survived: killed mid-spill (exit 137), cursor resumed,
+    # sealed manifest covering exactly the scored shards.
+    score_legs = [
+        leg
+        for cycle in report["cycles"]
+        for leg in cycle["legs"]
+        if leg["job"] == "score_all"
+    ]
+    assert len(score_legs) == len(report["cycles"])
+    kill_legs = [leg for leg in score_legs if "kill_rc" in leg]
+    assert len(kill_legs) == 1
+    assert kill_legs[0]["kill_rc"] == 137
+    assert kill_legs[0]["rc"] == 0 and kill_legs[0]["resumed"] is True
+    assert kill_legs[0]["score_violations"] == []
     # The mesh leg drives a row-sharded streamed fit every cycle, and the
     # schedule pins an `als.shard.gather` arm on one smoke cycle — the
     # sharded path's chaos surface must have been OBSERVED firing.
