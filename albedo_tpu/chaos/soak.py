@@ -12,7 +12,10 @@ soak run:
    observable; the ``loss`` cycle is the DEVICE-LOSS cycle: its mesh leg
    runs the elastic fit drill (a shard dies mid-sweep, the fit must
    checkpoint -> remesh -> resume to parity) plus the degraded-serving
-   drill (a bank sealed at the full rung promotes onto the halved rung);
+   drill (a bank sealed at the full rung promotes onto the halved rung),
+   and its stream leg arms ``stream.foldin.collective:loss`` on a forced
+   mesh stream (remesh-and-complete in the subprocess flavor, clean
+   ``MeshLost`` on the in-process 1-device rung);
 2. runs ``--soak-cycles`` full loops, each: a **mesh boot** (degraded-remesh
    ladder), the **offline pipeline** (ingest -> train_als -> canary publish,
    a real CLI subprocess so kill/term faults genuinely kill something), a
@@ -102,6 +105,7 @@ STREAM_FAULTS = (
     ("stream.ingest", "error"),
     ("stream.drift", "error"),
     ("stream.foldin", "error"),
+    ("stream.foldin.collective", "loss"),
     ("capacity.admit", "oom"),
 )
 SERVE_FAULTS = (
@@ -223,6 +227,23 @@ def build_schedule(
                 if s == "als.shard.collective"
                 or not (s.startswith("als.shard.") and k in ("error", "ioerror", "oom", "loss"))
             ]
+    # The device-loss cycle ALSO pins the STREAMING loss surface: its stream
+    # leg arms `stream.foldin.collective:loss` so every soak drills a device
+    # dying mid-fold-in, not just mid-refit. Replacing the whole leg strips
+    # any random raising draw that would fail the stream before the armed
+    # loss fires (the same reason the elastic mesh leg runs alone). The leg
+    # forces a mesh stream (see the stream-leg dispatch): the subprocess
+    # flavor boots 2 virtual host devices and must remesh 2 -> 1 and
+    # COMPLETE the cycle (rc 0); the in-process smoke is stuck on the one
+    # real CPU device, where the contract is a CLEAN MeshLost (rc 1) —
+    # mirroring `_elastic_fit_drill`'s 1-device branch. `loss` evidence
+    # stays canonical on the mesh leg (KIND_EVIDENCE).
+    for c in range(cycles):
+        if any(
+            s == "als.shard.collective" and k == "loss"
+            for s, k, _ in schedule[c]["mesh"]
+        ):
+            schedule[c]["stream"] = [("stream.foldin.collective", "loss", 1)]
     # A kill/term pipeline leg must not ALSO carry raising faults that could
     # fail the stage before the preemption fires.
     for c in range(cycles):
@@ -447,6 +468,7 @@ def _pipeline_in_process(ctx_factory, specs, resume: bool) -> dict:
 
 def _stream_in_process(ctx_factory, args, specs, cycle_seed: int) -> dict:
     from albedo_tpu.builders.pipeline import PipelineStageFailed, PublishRejected
+    from albedo_tpu.parallel.elastic import MeshLost
     from albedo_tpu.streaming.foldin import FoldInDiverged
     from albedo_tpu.streaming.job import run_stream
 
@@ -457,14 +479,27 @@ def _stream_in_process(ctx_factory, args, specs, cycle_seed: int) -> dict:
         max_foldin_batch=16, probe_users=40, no_publish=False,
         keep_stream=3, refit_checkpoint_every=2,
     )
+    # The device-loss cycle forces a MESH stream so the armed fold-in loss
+    # has a collective to kill. In-process the mesh is pinned at the one
+    # real CPU device: no rung below exists, so the contract is a CLEAN
+    # MeshLost (rc 1) — the same 1-device branch `_elastic_fit_drill`
+    # validates for the refit path.
+    run_args = args
+    ctx = ctx_factory()
+    if any(s == "stream.foldin.collective" for s, _, _ in specs):
+        run_args = argparse.Namespace(**vars(args))
+        run_args.mesh_devices = 1
+        ctx.args = run_args
     rc, err = 0, None
     with _InProcessArm(specs) as armed:
         try:
-            run_stream(ctx_factory(), args, opts)
+            run_stream(ctx, run_args, opts)
         except FoldInDiverged as e:
             rc, err = 3, repr(e)
         except PublishRejected as e:
             rc, err = 4, repr(e)
+        except MeshLost as e:
+            rc, err = 1, repr(e)
         except PipelineStageFailed as e:
             rc, err = 1, repr(e)
         except Exception as e:  # noqa: BLE001 — the CLI would exit 1 too
@@ -962,11 +997,23 @@ def run_soak(
             )
 
         if subprocess_legs:
+            stream_args = [
+                "--small", "--cycles", "1", "--delta-batch", "60",
+                "--stream-seed", str(seed + c), "--probe-users", "40",
+            ]
+            stream_env = None
+            if any(s == "stream.foldin.collective" for s, _, _ in plan["stream"]):
+                # The device-loss cycle's stream leg: 2 virtual host devices,
+                # so the injected fold-in loss has a rung below to remesh
+                # onto — the cycle must COMPLETE on 1 shard (rc 0), with the
+                # loss on the journal's mesh_events trail.
+                stream_args += ["--mesh-devices", "2"]
+                stream_env = {
+                    "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+                }
             stream_rec = _run_cli(
-                "run_stream",
-                ["--small", "--cycles", "1", "--delta-batch", "60",
-                 "--stream-seed", str(seed + c), "--probe-users", "40"],
-                plan["stream"], leg_timeout,
+                "run_stream", stream_args, plan["stream"], leg_timeout,
+                extra_env=stream_env,
             )
         else:
             stream_rec = _stream_in_process(

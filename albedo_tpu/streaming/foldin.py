@@ -40,6 +40,20 @@ The ``stream.foldin`` fault site fires ahead of every batch solve: an
 the real detect -> remediate path (the ``train.watchdog`` convention), and
 a ``kill`` kind dies mid-fold-in — the half-applied state must never reach
 the artifact store (pinned by the chaos drill).
+
+**Mesh mode** (``mesh=`` at construction): the frozen item side is
+row-sharded over the mesh and every batch is owner-routed and solved
+per-shard by `parallel.foldin.ShardedFoldIn` — the ALX layout with the PR 8
+ring/all-gather assembly, mode-selected per batch by the
+``plan_foldin(n_devices=, mode=)`` admission ladder (an over-budget
+all-gather transient degrades to the ring rung instead of refusing). The
+per-shard watchdog partials are psum'd into one replicated health vector
+whose d2h read stays the completion barrier, and every dispatch runs under
+the elastic collective deadline: a dead shard raises loss-shaped through
+``stream.foldin.collective`` and the streaming cycle (streaming/job.py)
+remeshes down the ladder and re-solves. ``stream.foldin.publish`` fires
+ahead of the bank-subscriber publish fan-out so drills can fail the
+publish edge specifically.
 """
 
 from __future__ import annotations
@@ -61,6 +75,12 @@ if TYPE_CHECKING:  # pragma: no cover
 log = logging.getLogger(__name__)
 
 FOLDIN_FAULT = faults.site("stream.foldin")
+# Fires ahead of the bank-subscriber publish fan-out (mesh and single-device
+# alike): an `error` kind fails the cycle AFTER the solves but BEFORE any
+# row reaches the serving bank — drilling that the publish edge is
+# all-or-nothing (the watchdog-cleared rows are still returned to the
+# caller's factor table only when the whole call succeeds).
+FOLDIN_PUBLISH_FAULT = faults.site("stream.foldin.publish")
 
 _foldin_solve_jit = None
 
@@ -104,6 +124,11 @@ class FoldInEngine:
     regularization would bias every folded row relative to the refit path.
     ``max_batch`` bounds the user-axis bucket (requests beyond it split into
     multiple dispatches); ``max_rms`` is the watchdog norm ceiling.
+    ``mesh`` switches the engine to the mesh-resident substrate
+    (`parallel.foldin.ShardedFoldIn`: item side row-sharded, owner-routed
+    per-shard solves, deadline-guarded collectives); ``shard_mode`` is the
+    PREFERRED source assembly there — ``allgather`` lets the admission
+    ladder degrade to ring per batch, ``ring`` pins ring.
     """
 
     def __init__(
@@ -113,11 +138,10 @@ class FoldInEngine:
         alpha: float | None = None,
         max_batch: int = 64,
         max_rms: float = 1e4,
+        mesh=None,
+        shard_mode: str = "allgather",
     ):
-        import jax.numpy as jnp
-
         from albedo_tpu.models.als import ImplicitALS
-        from albedo_tpu.ops.als import gramian
 
         # None = the estimator's own defaults, so an engine built without
         # explicit hyperparameters matches a model trained without them.
@@ -126,10 +150,34 @@ class FoldInEngine:
         self.alpha = float(ImplicitALS.alpha if alpha is None else alpha)
         self.max_batch = max(1, _pow2(int(max_batch)))
         self.max_rms = float(max_rms)
-        # Frozen item side, uploaded once: the factors and their Gramian are
-        # shared by every batch of every cycle.
-        self._vf = jnp.asarray(np.asarray(model.item_factors, dtype=np.float32))
-        self._yty = gramian(self._vf)
+        self.n_items = int(np.asarray(model.item_factors).shape[0])
+        self.mesh = mesh
+        self.shard_mode = str(shard_mode)
+        self.last_admission: dict | None = None
+        if mesh is not None:
+            # Mesh-resident substrate: the full item table is never uploaded
+            # to one device — ShardedFoldIn row-shards it and psums the
+            # Gramian. n_users fixes owner routing to the user table's own
+            # shard geometry.
+            from albedo_tpu.parallel.foldin import ShardedFoldIn
+
+            uf = getattr(model, "user_factors", None)
+            self._sharded = ShardedFoldIn(
+                mesh, model.item_factors, mode=self.shard_mode,
+                n_users=0 if uf is None else int(np.asarray(uf).shape[0]),
+            )
+            self._vf = None
+            self._yty = None
+        else:
+            import jax.numpy as jnp
+
+            from albedo_tpu.ops.als import gramian
+
+            # Frozen item side, uploaded once: the factors and their Gramian
+            # are shared by every batch of every cycle.
+            self._sharded = None
+            self._vf = jnp.asarray(np.asarray(model.item_factors, dtype=np.float32))
+            self._yty = gramian(self._vf)
         self._executables: dict[tuple[int, int], object] = {}
         self.batches_run = 0
         self.users_solved = 0
@@ -171,7 +219,7 @@ class FoldInEngine:
         if not capacity.enabled():
             return 1 << 62
         return capacity.max_foldin_entries(
-            self.rank, int(self._vf.shape[0]), length=length
+            self.rank, self.n_items, length=length
         )
 
     # ----------------------------------------------------------- executables
@@ -183,6 +231,10 @@ class FoldInEngine:
         import jax
         import jax.numpy as jnp
 
+        if self._sharded is not None:
+            raise RuntimeError(
+                "single-device executable requested on a mesh-mode engine"
+            )
         key = (bucket, length)
         compiled = self._executables.get(key)
         if compiled is not None:
@@ -222,7 +274,16 @@ class FoldInEngine:
                 cap = self.rung_cap(ln)
                 while bb > 1 and bb * ln > cap:
                     bb //= 2
-                self._executable(bb, ln)
+                if self._sharded is not None:
+                    # Mesh rung: the uniform-routing slab shape (skewed
+                    # routings pow2-quantize up and compile on first use).
+                    n = self._sharded.n_shards
+                    b_per = _pow2(max(1, -(-bb // n)))
+                    self._sharded.warm(n * b_per, ln, mode=self.shard_mode)
+                else:
+                    self._executable(bb, ln)
+        if self._sharded is not None:
+            return len(self._sharded._executables)
         return len(self._executables)
 
     # ----------------------------------------------------------------- solve
@@ -266,21 +327,70 @@ class FoldInEngine:
         capped_b = nominal_b
         while capped_b > 1 and capped_b * nominal_l > nominal_cap:
             capped_b //= 2
-        verdict = capacity.admit(
-            capacity.plan_foldin(
-                capped_b, nominal_l, self.rank, int(self._vf.shape[0])
-            ),
-            degradable=True,
-        )
-        # degrade_cap < the call's nominal rung forces a visible split; None
-        # = the per-length budget alone governs.
         degrade_cap = None
-        if verdict.verdict == "degrade":
-            degrade_cap = max(1, (capped_b * nominal_l) // 2)
-            log.warning(
-                "fold-in ladder capped at %d entries (%s)",
-                degrade_cap, verdict.detail,
+        mode = self.shard_mode
+        if self._sharded is not None:
+            # Mesh admission: an ordered ladder of assembly modes at THIS
+            # mesh's per-device price — the all-gather transient is the
+            # expensive term, so its degraded rung is ring (two 1/n shards
+            # in flight instead of the whole table). A refuse never kills
+            # the stream: fold-in keeps the single-device path's
+            # never-refuse contract by pinning ring and halving the entry
+            # cap so the batch provably splits.
+            n = self._sharded.n_shards
+            plans = [
+                capacity.plan_foldin(
+                    capped_b, nominal_l, self.rank, self.n_items,
+                    n_devices=n, mode=m,
+                )
+                for m in (("ring",) if self.shard_mode == "ring"
+                          else ("allgather", "ring"))
+            ]
+            verdict = capacity.admit_ladder(plans)
+            if verdict.chosen == "foldin_sharded_ring":
+                mode = "ring"
+            if verdict.verdict == "refuse":
+                mode = "ring"
+                degrade_cap = max(1, (capped_b * nominal_l) // 2)
+                log.warning(
+                    "sharded fold-in refused at every rung; pinning ring "
+                    "with a %d-entry cap (%s)", degrade_cap, verdict.detail,
+                )
+            elif verdict.verdict == "degrade" and mode == self.shard_mode:
+                # Degraded but not by mode (single-plan ladder): split.
+                degrade_cap = max(1, (capped_b * nominal_l) // 2)
+            self.last_admission = {
+                "verdict": verdict.verdict,
+                "chosen": verdict.chosen or verdict.workload,
+                "mode": mode,
+                "n_devices": n,
+                "required_mb": round(verdict.required_bytes / 1e6, 3),
+                "budget_mb": round(verdict.budget_bytes / 1e6, 3),
+            }
+        else:
+            verdict = capacity.admit(
+                capacity.plan_foldin(
+                    capped_b, nominal_l, self.rank, self.n_items
+                ),
+                degradable=True,
             )
+            # degrade_cap < the call's nominal rung forces a visible split;
+            # None = the per-length budget alone governs.
+            if verdict.verdict == "degrade":
+                degrade_cap = max(1, (capped_b * nominal_l) // 2)
+                log.warning(
+                    "fold-in ladder capped at %d entries (%s)",
+                    degrade_cap, verdict.detail,
+                )
+            self.last_admission = {
+                "verdict": verdict.verdict,
+                "chosen": verdict.workload,
+                "mode": None,
+                "n_devices": 1,
+                "required_mb": round(verdict.required_bytes / 1e6, 3),
+                "budget_mb": round(verdict.budget_bytes / 1e6, 3),
+            }
+        uidx = None if user_idx is None else np.asarray(user_idx, dtype=np.int64)
         out = np.empty((len(rows), self.rank), dtype=np.float32)
         i = 0
         while i < len(rows):
@@ -300,15 +410,23 @@ class FoldInEngine:
             if take < min(self.max_batch, len(rows) - i):
                 self.rung_capped += 1
             chunk = rows[i:i + take]
-            out[i:i + len(chunk)] = self._solve_chunk(chunk)
+            if self._sharded is not None:
+                chunk_uidx = None if uidx is None else uidx[i:i + take]
+                out[i:i + len(chunk)] = self._solve_chunk_sharded(
+                    chunk, chunk_uidx, mode
+                )
+            else:
+                out[i:i + len(chunk)] = self._solve_chunk(chunk)
             i += take
-        if self._bank_subscribers and user_idx is not None:
+        if self._bank_subscribers and uidx is not None:
             # Only after EVERY chunk passed the watchdog: a diverged batch
             # raised above and nothing reached the serving bank (the same
             # nothing-publishes contract the stream generation write keeps).
-            idx = np.asarray(user_idx, dtype=np.int64)
+            # The publish edge has its own fault site so drills can fail it
+            # specifically — all-or-nothing, ahead of the first bank.
+            FOLDIN_PUBLISH_FAULT.hit()
             for bank, source in self._bank_subscribers:
-                bank.publish_user_rows(source, idx, out)
+                bank.publish_user_rows(source, uidx, out)
         return out
 
     def _solve_chunk(self, chunk: list[tuple[np.ndarray, np.ndarray]]) -> np.ndarray:
@@ -382,3 +500,65 @@ class FoldInEngine:
         self.users_solved += len(chunk)
         self.last_batch_s = time.perf_counter() - t0
         return np.asarray(solved_dev, dtype=np.float32)[: len(chunk)]
+
+    def _solve_chunk_sharded(
+        self, chunk, chunk_user_idx, mode: str
+    ) -> np.ndarray:
+        """One chunk on the mesh: owner-route, slab, per-shard solve, and
+        the SAME watchdog contract as the single-device path — the fused
+        per-shard health reduction (psum'd to one replicated vector inside
+        the solve program) is judged host-side, a trip re-solves once
+        damped 10x through the same executable, and only a surviving trip
+        raises :class:`FoldInDiverged`."""
+        from albedo_tpu.utils.watchdog import factor_health, health_dict
+
+        t0 = time.perf_counter()
+        sh = self._sharded
+        owners = None if chunk_user_idx is None else sh.owners(chunk_user_idx)
+        idx, val, mask, pos = sh.build_slab(chunk, owners)
+
+        # Same chaos hook as the single-device path: `kill` dies genuinely
+        # mid-fold-in, `error` scribbles NaN so detect -> remediate runs.
+        scribble = False
+        try:
+            FOLDIN_FAULT.hit()
+        except FaultInjected:
+            scribble = True
+
+        # RMS over the routed padded slab dilutes by the empty slots; undo
+        # it so the verdict matches the unpadded reduction.
+        rms_scale = (idx.shape[0] / len(chunk)) ** 0.5
+
+        def check(health_vec) -> dict:
+            health = health_dict(health_vec)
+            health["rms"] *= rms_scale
+            return health
+
+        solved, health_vec = sh.solve(
+            idx, val, mask, self.reg_param, self.alpha, mode=mode
+        )
+        if scribble:
+            # Chaos-only path: poison the host copy and judge that, so the
+            # detect -> remediate flow below runs for real.
+            poisoned = solved[pos].copy()
+            poisoned.flat[0] = np.nan
+            health = health_dict(factor_health(poisoned, poisoned))
+        else:
+            health = check(health_vec)
+        if health["nonfinite"] or health["rms"] > self.max_rms:
+            self.trips += 1
+            events.watchdog_trips.inc(kind="foldin")
+            log.warning(
+                "sharded fold-in batch tripped the watchdog (%s); "
+                "re-solving damped", health,
+            )
+            solved, health_vec = sh.solve(
+                idx, val, mask, self.reg_param * 10.0, self.alpha, mode=mode
+            )
+            health = check(health_vec)
+            if health["nonfinite"] or health["rms"] > self.max_rms:
+                raise FoldInDiverged(len(chunk), health)
+        self.batches_run += 1
+        self.users_solved += len(chunk)
+        self.last_batch_s = time.perf_counter() - t0
+        return solved[pos]

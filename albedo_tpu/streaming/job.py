@@ -21,8 +21,20 @@ Wires the streaming subsystem end to end, per cycle::
 
 Every cycle lands in the stream journal
 (``<tag>-stream-journal.json``). Exit codes follow the pipeline contract:
-0 ok, 1 stage failure, 3 fold-in divergence, 4 refit refused by the canary
-gate, 75 preempted.
+0 ok, 1 stage failure (including a mesh lost beyond the degradation
+ladder), 3 fold-in divergence, 4 refit refused by the canary gate, 75
+preempted.
+
+With the global ``--mesh-devices N`` the stream is a first-class mesh
+citizen: fold-in solves on the mesh-resident substrate
+(``parallel/foldin.py`` — item side row-sharded, owner-routed per-shard
+solves, ring/all-gather assembly picked per batch by the
+``plan_foldin(n_devices=, mode=)`` admission ladder), the drift refit runs
+``elastic_sharded_fit``, and a device loss mid-fold-in drains the cycle to
+its last sealed publish, remeshes down the 8 -> 4 -> 2 -> 1 ladder and
+re-solves the interrupted batch on the smaller rung (journal
+``mesh_events`` trail; out of rungs -> clean exit 1 with the newest sealed
+artifact still loadable).
 
 Staleness model: the serving swap lag is one watch interval behind the
 publish, the publish is one cycle behind the crawl — minutes, not the
@@ -56,6 +68,15 @@ class StreamState:
     def __init__(self, ctx, model, matrix, opts):
         self.opts = opts
         self.base_artifact_name = ctx.als_artifact_name()
+        # Mesh posture for the whole stream: --mesh-devices routes fold-in
+        # through the mesh-resident substrate (parallel/foldin.py) and the
+        # drift refit through the elastic sharded fit. The CURRENT rung
+        # lives here (not on the boot context) because a mid-stream device
+        # loss remeshes it down the ladder — rebase must not resurrect the
+        # dead boot rung.
+        self.mesh = ctx.mesh()
+        self.shard_mode = getattr(ctx.args, "shard_mode", "allgather") or "allgather"
+        self.n_devices = 1 if self.mesh is None else int(self.mesh.devices.size)
         self.rebase(model, matrix, probe_ctx=ctx)
         self.fold_out_frames: list = []
         t_max = float(ctx.tables().starring["starred_at"].max())
@@ -83,11 +104,31 @@ class StreamState:
         self.engine = FoldInEngine(
             model, reg_param=ALS_REG, alpha=ALS_ALPHA,
             max_batch=self.opts.max_foldin_batch,
+            mesh=self.mesh, shard_mode=self.shard_mode,
         )
         self.uf = np.array(model.user_factors, dtype=np.float32, copy=True)
         self.vf = np.asarray(model.item_factors, dtype=np.float32)
         self.rank = int(model.rank)
         self.probe_dense = probe_ctx.test_user_dense(self.opts.probe_users)
+
+    def remesh(self, rung: int) -> None:
+        """Rebuild the fold-in engine on a smaller ladder rung after a
+        device loss: the frozen item side re-shards onto the survivors and
+        the per-rung AOT ladder re-acquires on first dispatch. Bank
+        subscriptions carry over — the sharded bank keeps receiving folded
+        rows on whatever rung the stream now has."""
+        from albedo_tpu.builders.jobs import ALS_ALPHA, ALS_REG
+        from albedo_tpu.parallel.mesh import make_mesh
+
+        subscribers = list(self.engine._bank_subscribers)
+        self.mesh = make_mesh(rung)
+        self.n_devices = int(self.mesh.devices.size)
+        self.engine = FoldInEngine(
+            self.model_base, reg_param=ALS_REG, alpha=ALS_ALPHA,
+            max_batch=self.opts.max_foldin_batch,
+            mesh=self.mesh, shard_mode=self.shard_mode,
+        )
+        self.engine._bank_subscribers = subscribers
 
     @property
     def fold_out_rows(self) -> int:
@@ -211,12 +252,41 @@ def _full_refit(ctx, args, state: StreamState, refit_no: int) -> dict:
     if not getattr(rargs, "checkpoint_every", 0):
         rargs.checkpoint_every = state.opts.refit_checkpoint_every
     rargs.resume = False
+    if state.mesh is not None:
+        # The refit trains on the stream's CURRENT rung (a mid-stream loss
+        # may have degraded it below --mesh-devices), and a mesh + the
+        # forced checkpoint interval route train_als through
+        # elastic_sharded_fit — a mid-refit device loss degrades the mesh
+        # there instead of killing the stream.
+        rargs.mesh_devices = state.n_devices
     refit_tag = md5(f"{ctx.tag}-stream-refit-{refit_no}")[:10]
     rctx = JobContext(rargs, tables=tables, tag=refit_tag)
-    journal = run_pipeline(
-        rctx, stages=["ingest", "train_als", "canary"], verbose=True
+    losses_before = events.mesh_losses.total()
+    try:
+        journal = run_pipeline(
+            rctx, stages=["ingest", "train_als", "canary"], verbose=True
+        )
+    except BaseException as e:
+        # Outcome-split the refit counter so a degraded-but-alive stream is
+        # distinguishable from a dead one on /metrics: `mesh_lost` = the
+        # elastic driver ran out of rungs/budget mid-refit, `failed` = any
+        # other stage failure.
+        from albedo_tpu.parallel.elastic import MeshLost
+
+        chain, seen = [], e
+        while seen is not None and seen not in chain:
+            chain.append(seen)
+            seen = seen.__cause__ or seen.__context__
+        outcome = (
+            "mesh_lost" if any(isinstance(c, MeshLost) for c in chain)
+            else "failed"
+        )
+        events.drift_refits.inc(outcome=outcome)
+        raise
+    lost = events.mesh_losses.total() - losses_before
+    events.drift_refits.inc(
+        outcome="completed_degraded" if lost else "completed"
     )
-    events.drift_refits.inc()
     canary = journal["stages"]["canary"]["result"] or {}
     score = float(canary.get("score") or 0.0)
     state.base_artifact_name = rctx.als_artifact_name()
@@ -232,6 +302,8 @@ def _full_refit(ctx, args, state: StreamState, refit_no: int) -> dict:
         "canary_score": score,
         "n_users": int(rctx.matrix().n_users),
         "n_items": int(rctx.matrix().n_items),
+        "n_devices": int(state.n_devices),
+        "mesh_losses": int(lost),
     }
 
 
@@ -273,6 +345,11 @@ def _publish(
             "fold_out_queue_rows": state.fold_out_rows,
             "n_users": int(state.matrix.n_users),
             "n_items": int(state.matrix.n_items),
+            # The mesh rung the folded rows were solved on. A stamp gate
+            # must TOLERATE rung changes (serving/reload.py): the layout is
+            # a process choice, not an artifact property — the same rule
+            # PR 12 established for bank promotion.
+            "n_devices": int(state.n_devices),
         },
     })
     store.write_manifest(path)
@@ -290,6 +367,66 @@ def _publish(
             except OSError:
                 pass
     return {"artifact": name, "generation": g}
+
+
+# Same budget as elastic_sharded_fit's max_losses default: one loss per
+# stream is survivable-by-remesh; a second means the hardware is dying
+# faster than degradation helps and the stream fails clean (MeshLost).
+_MAX_STREAM_LOSSES = 1
+
+
+def _elastic_fold_in(state: StreamState, mesh_events: dict, rows, t_arr):
+    """Fold one batch with the training fit's elasticity contract.
+
+    A loss-shaped failure (dead shard, injected ``stream.foldin.collective``
+    loss, collective-deadline trip) drains the cycle to its last sealed
+    publish — ``state.uf`` and the serving bank are untouched because
+    ``fold_in`` only lands after EVERY chunk passes the watchdog — then the
+    mesh drops one ladder rung and the SAME batch re-solves on the
+    survivors (admission re-priced per rung by the engine, recorded in the
+    remesh trail). Out of rungs or over the loss budget raises
+    :class:`~albedo_tpu.parallel.elastic.MeshLost`: the cycle's journal
+    failure path records it and the CLI exits 1, with the newest sealed
+    artifact still the one a reload watcher loads."""
+    from albedo_tpu.utils.retry import is_collective_lost
+
+    resume_pending = False
+    while True:
+        try:
+            out = state.engine.fold_in(rows, user_idx=t_arr)
+        except Exception as e:  # noqa: BLE001 — classified below
+            if state.mesh is None or not is_collective_lost(e):
+                raise
+            from albedo_tpu.parallel.elastic import MeshLost
+            from albedo_tpu.parallel.mesh import next_ladder_rung
+
+            mesh_events["losses"] += 1
+            events.mesh_losses.inc()
+            n_now = state.n_devices
+            rung = next_ladder_rung(n_now)
+            if mesh_events["losses"] > _MAX_STREAM_LOSSES or rung is None:
+                events.elastic_resumes.inc(outcome="failed")
+                raise MeshLost(state.generation, e) from e
+            print(
+                f"[run_stream] device loss mid-fold-in on {n_now} shard(s): "
+                f"{e!r}; remeshing to {rung} and re-solving the batch"
+            )
+            state.remesh(rung)
+            mesh_events["remeshes"].append({
+                "generation": int(state.generation),
+                "from_shards": int(n_now),
+                "to_shards": int(rung),
+                "cause": repr(e)[-200:],
+            })
+            resume_pending = True
+            continue
+        if resume_pending:
+            mesh_events["resumes"] += 1
+            events.elastic_resumes.inc(outcome="resumed")
+            # The re-solve's per-rung admission pricing closes the trail
+            # entry — the journal shows what the smaller rung admitted.
+            mesh_events["remeshes"][-1]["admission"] = state.engine.last_admission
+        return out
 
 
 def run_stream(ctx, args, opts) -> dict:
@@ -324,6 +461,15 @@ def run_stream(ctx, args, opts) -> dict:
         "status": "running",
         "baseline": {
             "score": monitor.baseline, "source": monitor.baseline_source,
+        },
+        # The fit-report contract from PR 12, for the stream: losses, the
+        # remesh trail (with per-rung admission pricing), resumes. A
+        # degraded stream cycle is visible here, not just in stderr.
+        "mesh_events": {
+            "n_shards_start": int(state.n_devices),
+            "losses": 0,
+            "resumes": 0,
+            "remeshes": [],
         },
         "cycles": [],
     }
@@ -380,8 +526,11 @@ def run_stream(ctx, args, opts) -> dict:
             if rows:
                 # user_idx rides along so any attached retrieval bank
                 # (FoldInEngine.attach_bank) receives the fresh rows too.
-                state.uf[np.asarray(t_idx, dtype=np.int64)] = state.engine.fold_in(
-                    rows, user_idx=np.asarray(t_idx, dtype=np.int64)
+                # The elastic wrapper survives a device loss by remeshing
+                # down the ladder and re-solving this same batch.
+                t_arr = np.asarray(t_idx, dtype=np.int64)
+                state.uf[t_arr] = _elastic_fold_in(
+                    state, journal["mesh_events"], rows, t_arr
                 )
             foldin_s = time.perf_counter() - f0
             events.foldin_users.inc(len(rows))
@@ -392,6 +541,10 @@ def run_stream(ctx, args, opts) -> dict:
                 "batches": state.engine.batches_run - batches_before,
                 "foldin_s": round(foldin_s, 4),
             }
+            if state.mesh is not None:
+                record["foldin"]["n_devices"] = int(state.n_devices)
+                if state.engine.last_admission is not None:
+                    record["foldin"]["admission"] = state.engine.last_admission
 
             # 3. Drift check (every --drift-every cycles) + refit trigger.
             refit_due, why = False, []
@@ -452,6 +605,7 @@ def run_stream(ctx, args, opts) -> dict:
         )
 
     journal["status"] = "complete"
+    journal["mesh_events"]["n_shards"] = int(state.n_devices)
     journal["summary"] = {
         "cycles": len(journal["cycles"]),
         "deltas_applied": int(state.deltas_total),
@@ -484,6 +638,7 @@ def run_stream_job(args) -> int | None:
     """
     from albedo_tpu.builders.jobs import JobContext
     from albedo_tpu.builders.pipeline import PipelineStageFailed, PublishRejected
+    from albedo_tpu.parallel.elastic import MeshLost
 
     extra = argparse.ArgumentParser()
     extra.add_argument("--cycles", type=int, default=3)
@@ -510,6 +665,13 @@ def run_stream_job(args) -> int | None:
     except FoldInDiverged as e:
         print(f"[run_stream] FOLD-IN DIVERGED: {e} (nothing published this cycle)")
         return EXIT_REFUSED
+    except MeshLost as e:
+        # Out of ladder rungs / over the loss budget mid-fold-in: the cycle
+        # drained to its last sealed publish (a reload watcher still loads
+        # the newest sealed artifact), so this is a clean failure, not a
+        # half-applied stream.
+        print(f"[run_stream] MESH LOST mid-stream: {e}")
+        return EXIT_FAILURE
     except PublishRejected as e:
         print(f"[run_stream] REFIT REFUSED by the canary gate: {e}")
         return EXIT_REJECTED

@@ -552,21 +552,47 @@ def plan_foldin(
     length: int,
     rank: int,
     n_items: int,
+    n_devices: int = 1,
+    mode: str = "allgather",
 ) -> CapacityPlan:
-    """Price one fold-in ladder rung: the frozen item side (factors +
-    Gramian, resident across every batch) plus the rung's padded slab and
-    its gathered block."""
-    item_side = n_items * rank * 4 + rank * rank * 4
-    slab = bucket * length * (4 + 4 + 1)
-    gathered = bucket * length * rank * 4 + bucket * rank * rank * 4
-    return CapacityPlan(
-        workload="foldin",
-        items={
-            "frozen_item_side": item_side,
-            "rung_slab": slab,
-            "rung_gather": gathered,
-        },
-    )
+    """Price one fold-in ladder rung, PER DEVICE: the frozen item side
+    (factors + Gramian, resident across every batch) plus the rung's padded
+    slab and its gathered block.
+
+    ``n_devices > 1`` prices the mesh-resident fold-in (parallel/foldin.py):
+    the frozen item table is row-sharded (each device holds 1/n of the
+    padded table plus a replicated Gramian), the user slab is routed so each
+    shard solves ``bucket // n`` of its own users, and ``mode`` picks the
+    source-assembly transient — ``allgather`` materialises the whole padded
+    item table per batch, ``ring`` only ever holds two 1/n shards (the
+    resident one plus the ppermute'd one in flight). This is the same
+    allgather-vs-ring footprint split ``plan_fit_sharded`` prices for
+    training, and it is what lets ``admit_ladder`` honestly degrade a
+    fold-in batch from allgather to ring when the gather transient is what
+    busts the budget.
+    """
+    n = max(1, int(n_devices))
+    i_pad = _shard_pad(n_items, n)
+    item_side = i_pad * rank * 4 // n + rank * rank * 4
+    slab = bucket * length * (4 + 4 + 1) // n
+    b_per = max(1, bucket // n)
+    gathered = b_per * length * rank * 4 + b_per * rank * rank * 4
+    items = {
+        "frozen_item_side": item_side,
+        "rung_slab": slab,
+        "rung_gather": gathered,
+    }
+    if n == 1:
+        workload = "foldin"
+    elif mode == "ring":
+        workload = "foldin_sharded_ring"
+        # Two source shards in flight: the resident one and the ppermute'd
+        # visitor (double-buffered, same as plan_fit_sharded's ring price).
+        items["transient_assembly"] = 2 * (i_pad // n) * rank * 4
+    else:
+        workload = "foldin_sharded"
+        items["transient_assembly"] = i_pad * rank * 4
+    return CapacityPlan(workload=workload, items=items)
 
 
 def plan_retrieval(

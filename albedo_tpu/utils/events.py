@@ -349,7 +349,11 @@ foldin_users = global_counter(
 drift_refits = global_counter(
     DRIFT_REFITS_TOTAL,
     "Full checkpointed refits triggered by the streaming drift monitor "
-    "(quality decay past tolerance, or fold-out queue overflow).",
+    "(quality decay past tolerance, or fold-out queue overflow), by "
+    "outcome: completed, completed_degraded (the elastic driver survived "
+    "a mid-refit device loss by remeshing), mesh_lost (out of rungs/"
+    "budget), failed (any other stage failure).",
+    ("outcome",),
 )
 stream_publishes = global_counter(
     STREAM_PUBLISHES_TOTAL,

@@ -1387,14 +1387,47 @@ def foldin_bench() -> dict:
     cycle, and the refit/fold-in wall-clock ratio — the number that says
     what the streaming path buys. Env knobs: ALBEDO_FOLDIN_USERS/ITEMS/
     MEAN_STARS/DELTA_BATCH/TRIALS/RANK/ITERS.
+
+    The **mesh rows** then walk the mesh-resident fold-in (parallel/
+    foldin.py: item side row-sharded, batches owner-routed) up 1 -> 2 -> 4
+    -> 8 virtual devices — sustained deltas/sec and staleness-seconds-per-
+    cycle (delta batch landed -> folded rows ready, the freshness lag a
+    stream cycle adds) per rung, with the per-rung admission record. The
+    ``out_of_core_10m_x_1m`` block is the analytic companion: the fold-in
+    admission ladder priced at the ROADMAP's 10M x 1M parameterization,
+    where the single-device engine's resident item side busts any one
+    device and only the sharded rungs admit. Extra knobs:
+    ALBEDO_FOLDIN_DEVICES/HOST_DEVICES/MODE/OUT (record lands in
+    FOLDIN_r01.json).
     """
     import statistics
+
+    # Virtual devices must be forced BEFORE jax initializes (the scale
+    # scenario's pattern); a real slice runs its hardware devices untouched.
+    host_devs = int(os.environ.get("ALBEDO_FOLDIN_HOST_DEVICES", "8"))
+    cpu_pinned = "cpu" in (
+        os.environ.get("JAX_PLATFORMS", ""),
+        os.environ.get("ALBEDO_BENCH_PLATFORM", ""),
+    )
+    if (
+        cpu_pinned
+        and host_devs > 1
+        and "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", "")
+    ):
+        os.environ["XLA_FLAGS"] = (
+            f"{os.environ.get('XLA_FLAGS', '')} "
+            f"--xla_force_host_platform_device_count={host_devs}"
+        ).strip()
+
+    import jax
 
     from albedo_tpu.datasets.synthetic import synthetic_stars
     from albedo_tpu.datasets.synthetic_tables import synthetic_delta_stream
     from albedo_tpu.models.als import ImplicitALS
+    from albedo_tpu.parallel.mesh import make_mesh
     from albedo_tpu.streaming.deltas import StarOverlay, validate_deltas
     from albedo_tpu.streaming.foldin import FoldInEngine
+    from albedo_tpu.utils import capacity
 
     n_users = int(os.environ.get("ALBEDO_FOLDIN_USERS", "5000"))
     n_items = int(os.environ.get("ALBEDO_FOLDIN_ITEMS", "2000"))
@@ -1417,7 +1450,8 @@ def foldin_bench() -> dict:
         matrix, n_batches=trials + 1, batch_size=delta_batch, seed=9
     )
 
-    def foldin_cycle(frame) -> dict:
+    def foldin_cycle(frame, eng=None) -> dict:
+        eng = engine if eng is None else eng
         overlay = StarOverlay(matrix)
         now = float(frame["starred_at"].max())
         t0 = time.perf_counter()
@@ -1425,14 +1459,14 @@ def foldin_bench() -> dict:
         touched = overlay.apply(batch)["touched_users"]
         rows = [overlay.user_row(du, now) for du in touched]
         rows = [(i, v) for i, v in rows if i.size]
-        batches_before = engine.batches_run
+        batches_before = eng.batches_run
         f0 = time.perf_counter()
-        solved = engine.fold_in(rows)
+        solved = eng.fold_in(rows)
         foldin_s = time.perf_counter() - f0
         cycle_s = time.perf_counter() - t0
         if not np.isfinite(solved).all():
             fail("foldin", "non-finite fold-in factors")
-        n_batches = engine.batches_run - batches_before
+        n_batches = eng.batches_run - batches_before
         return {
             "cycle_s": cycle_s,
             "foldin_s": foldin_s,
@@ -1463,7 +1497,61 @@ def foldin_bench() -> dict:
     foldin_batch_s = med("batch_s")
     refit_s = statistics.median(refit_trials)
     cycle_s = med("cycle_s")
-    return {
+
+    # --- mesh rows: the sharded fold-in walked up the device ladder -------
+    shard_mode = os.environ.get("ALBEDO_FOLDIN_MODE", "allgather")
+    visible = len(jax.devices())
+    mesh_counts = [
+        int(c)
+        for c in os.environ.get("ALBEDO_FOLDIN_DEVICES", "1,2,4,8").split(",")
+        if int(c) <= visible
+    ]
+    mesh_trials = max(1, min(3, trials))
+    mesh_rows = []
+    for n in mesh_counts:
+        eng = FoldInEngine(model, mesh=make_mesh(n), shard_mode=shard_mode)
+        foldin_cycle(batches[0], eng=eng)  # warm this rung's shape ladder
+        rung = [foldin_cycle(b, eng=eng) for b in batches[1 : mesh_trials + 1]]
+        rung_med = lambda key: statistics.median(t[key] for t in rung)  # noqa: E731
+        mesh_rows.append({
+            "n_devices": n,
+            "mode": shard_mode,
+            "deltas_per_s_median": round(rung_med("deltas_per_s"), 1),
+            "cycle_s_median": round(rung_med("cycle_s"), 4),
+            "foldin_s_median": round(rung_med("foldin_s"), 4),
+            # Freshness lag one stream cycle adds: delta batch landed ->
+            # folded rows ready to publish.
+            "staleness_s_per_cycle": round(rung_med("cycle_s"), 4),
+            "admission": eng.last_admission,
+        })
+
+    # --- the out-of-core 10M x 1M costing: fold-in at catalog scale -------
+    # The single-device engine's RESIDENT item side (1M x rank factors +
+    # Gramian) is what busts one device at the ROADMAP parameterization;
+    # the sharded rungs are what admit. Analytic — same convention as the
+    # scoring record's block.
+    ooc_users, ooc_items = 10_000_000, 1_000_000
+    ooc_bucket, ooc_length = 1024, 1024
+    ooc_n = max(mesh_counts[-1] if mesh_counts else 8, 8)
+    ooc_plans = [
+        capacity.plan_foldin(ooc_bucket, ooc_length, rank, ooc_items),
+        capacity.plan_foldin(
+            ooc_bucket, ooc_length, rank, ooc_items,
+            n_devices=ooc_n, mode="allgather",
+        ),
+        capacity.plan_foldin(
+            ooc_bucket, ooc_length, rank, ooc_items,
+            n_devices=ooc_n, mode="ring",
+        ),
+    ]
+    ooc_verdict = capacity.admit_ladder(ooc_plans)
+    # Projected staleness at catalog scale rides the measured per-rung
+    # throughput (virtual devices on a bench box: prices the path, not a
+    # slice).
+    best_dps = max(
+        (r["deltas_per_s_median"] for r in mesh_rows), default=0.0
+    )
+    record = {
         "metric": "foldin_batch_latency_s",
         **hardware_fields(),
         "unit": "seconds per touched-user fold-in batch (median)",
@@ -1482,7 +1570,39 @@ def foldin_bench() -> dict:
         "n_items": n_items,
         "delta_batch": delta_batch,
         "rank": rank,
+        "mesh_rows": mesh_rows,
+        "shard_mode": shard_mode,
+        "out_of_core_10m_x_1m": {
+            "n_users": ooc_users,
+            "n_items": ooc_items,
+            "bucket": ooc_bucket,
+            "length": ooc_length,
+            "n_devices": ooc_n,
+            "plans": {
+                p.workload: p.required_bytes for p in ooc_plans
+            },
+            "verdict": ooc_verdict.to_dict(),
+            "est_staleness_s_per_cycle": (
+                round(delta_batch / best_dps, 2) if best_dps else None
+            ),
+        },
+        "scale_note": (
+            "mesh rows use virtual host devices on a CPU bench box: they "
+            "price the sharded dataflow, not a real slice; the 10m x 1m "
+            "block is the analytic admission at catalog scale"
+        ),
     }
+    out_path = os.environ.get(
+        "ALBEDO_FOLDIN_OUT",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)), "FOLDIN_r01.json"),
+    )
+    try:
+        with open(out_path, "w") as f:
+            json.dump(record, f, indent=2)
+            f.write("\n")
+    except OSError as e:
+        record["record_write_error"] = repr(e)
+    return record
 
 
 def retrieval_bench() -> dict:

@@ -46,6 +46,52 @@ def _run_stream(env: dict, *extra_args: str) -> subprocess.CompletedProcess:
     return subprocess.run(cmd, capture_output=True, text=True, env=env, timeout=580)
 
 
+def test_device_loss_mid_foldin_remeshes_and_completes(tmp_path):
+    """Elastic drill: a device lost mid-fold-in at 8 virtual devices must not
+    kill the stream — the cycle drains, remeshes to 4, re-solves the batch on
+    the smaller rung, and the folded factors match an uninterrupted
+    single-device stream to 1e-5."""
+    import json
+    import pickle
+
+    import numpy as np
+
+    # Lossy run: 8 virtual CPU devices, injected collective loss on the first
+    # sharded fold-in dispatch.
+    lossy = tmp_path / "lossy"
+    env8 = _env(
+        lossy,
+        XLA_FLAGS="--xla_force_host_platform_device_count=8",
+        ALBEDO_FAULTS="stream.foldin.collective:loss@1",
+    )
+    res = _run_stream(env8, "--mesh-devices", "8")
+    assert res.returncode == 0, (res.returncode, res.stderr[-2000:])
+
+    journal = json.loads(
+        next(lossy.rglob("*stream-journal.json")).read_text()
+    )
+    me = journal["mesh_events"]
+    assert me["n_shards_start"] == 8
+    assert me["losses"] >= 1 and me["resumes"] >= 1, me
+    assert me["remeshes"] and me["remeshes"][0]["from_shards"] == 8
+    assert me["remeshes"][0]["to_shards"] == 4
+    assert me["remeshes"][0]["admission"]["n_devices"] == 4
+    assert me["n_shards"] == 4
+
+    # Clean single-device reference stream on a separate store, same seeds.
+    clean = tmp_path / "clean"
+    ref = _run_stream(_env(clean))
+    assert ref.returncode == 0, ref.stderr[-2000:]
+
+    def factors(root: Path) -> np.ndarray:
+        with open(next(root.rglob("*stream-g1.pkl")), "rb") as fh:
+            return np.asarray(pickle.load(fh)["user_factors"])
+
+    got, want = factors(lossy), factors(clean)
+    assert got.shape == want.shape
+    assert np.allclose(got, want, atol=1e-5), float(np.max(np.abs(got - want)))
+
+
 def test_kill_mid_foldin_never_publishes_half_applied_delta(tmp_path):
     data = tmp_path / "data"
     env = _env(data)

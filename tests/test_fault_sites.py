@@ -57,6 +57,7 @@ def test_known_sites_are_present():
         "serving.breaker.<name>", "reload.load", "reload.validate",
         "data.validate", "train.watchdog", "pipeline.canary",
         "stream.ingest", "stream.foldin", "stream.drift",
+        "stream.foldin.collective", "stream.foldin.publish",
         "capacity.admit", "mesh.devices", "als.chunked",
         "als.shard.gather", "als.shard.stream", "als.shard.collective",
         "als.shard.prefetch", "retrieval.build", "retrieval.query",
