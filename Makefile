@@ -13,7 +13,7 @@ JOBS = popularity curation content train_als cv_als build_user_profile \
 .PHONY: $(JOBS) test test-all bench serve-bench datacheck-bench chaos \
         chaos-serve chaos-stream chaos-elastic stream stream-bench dryrun \
         soak soak-smoke capacity-bench retrieval-bench lint lint-baseline \
-        sanitize score score-bench
+        sanitize score score-bench loadgen chaos-load
 
 $(JOBS):
 	$(PY) -m albedo_tpu.cli $@ $(ARGS)
@@ -117,6 +117,20 @@ soak-smoke:
 # tier-1 flavor, the chaos-marked CLI drill is the subprocess acceptance.
 chaos-elastic:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_elastic.py -q
+
+# Open-loop load-harness smoke: the scheduled-tick latency, parity
+# accounting, and loadgen.tick hole-punch tests — seconds, no device work
+# (albedo_tpu/loadgen/; see README "Overload runbook").
+loadgen:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_loadgen.py tests/test_overload.py -q
+
+# Chaos under load: calibrate closed-loop capacity, then offer 2x open-loop
+# while firing hot-swap / reshard / fold-in publish / breaker-trip legs
+# mid-surge. Gates: zero 5xx, brownout engaged AND recovered, p999 bounded,
+# every chaos leg observed, request parity -> SERVING_r02.json (env knobs:
+# ALBEDO_OVERLOAD_USERS/ITEMS/SURGE_S/SLO/WORKERS/P999_BOUND).
+chaos-load:
+	JAX_PLATFORMS=cpu $(PY) bench.py overload
 
 # Capacity scenario: chunked-fallback overhead vs the device-resident fit
 # (interleaved trials, medians — per the bench-box throttling policy).

@@ -68,6 +68,7 @@ LOCKSMITH_PACKAGES = (
     "albedo_tpu/streaming/",
     "albedo_tpu/store/",
     "albedo_tpu/utils/",
+    "albedo_tpu/loadgen/",
 )
 
 _MUTEX_CTORS = {"threading.Lock", "threading.RLock"}

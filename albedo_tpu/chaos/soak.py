@@ -20,7 +20,9 @@ soak run:
    ladder), the **offline pipeline** (ingest -> train_als -> canary publish,
    a real CLI subprocess so kill/term faults genuinely kill something), a
    **serve leg** (validated hot-swap of the published artifact through the
-   real reload gates + live probes), a **stream leg** (validated delta
+   real reload gates + live probes + a short open-loop under-load burst
+   that must hold the overload contract: zero 5xx, offered/completed
+   parity, sheds priced as tier-tagged 429s), a **stream leg** (validated delta
    ingest -> fold-in -> stamped publish), and a **scoring leg** (the
    ``score_all`` batch sweep under drawn ``score.*`` faults; one pinned
    cycle per soak — the 2-cycle smoke included — runs it as a real CLI
@@ -820,8 +822,10 @@ def _degraded_serving_drill(ctx) -> dict:
 def _serve_leg(ctx, specs) -> dict:
     """In-process serving leg: boot a service on the current model, drive
     one validated reload of the newest published candidate through the REAL
-    gates (require_stamp on), then probe live traffic. The incumbent must
-    keep answering whatever the gates decide."""
+    gates (require_stamp on), then probe live traffic and run a short
+    open-loop under-load burst (PR 20's overload contract: zero 5xx,
+    offered/completed parity). The incumbent must keep answering whatever
+    the gates decide."""
     from albedo_tpu.serving import HotSwapManager, RecommendationService
 
     out: dict = {"job": "serve", "rc": 0, "fired": {}, "probes": 0,
@@ -888,6 +892,43 @@ def _serve_leg(ctx, specs) -> dict:
         if verdict.verdict == "refuse":
             out["rc"] = 1
             out["error"] = "degradable admission probe refused"
+        # Under-load leg (the overload contract in the soak loop, not just
+        # the dedicated bench): a short open-loop burst through the live
+        # service must hold zero 5xx and strict offered/completed parity —
+        # shed requests come back as priced, tier-tagged 429s.
+        from albedo_tpu.loadgen import OpenLoopLoadGen
+        from albedo_tpu.serving import QueueOverflow
+        from albedo_tpu.serving.batcher import DeadlineExceeded
+
+        def load_fn(i: int):
+            uid = int(users[i % len(users)])
+            try:
+                return service.handle_recommend(uid, k=5)
+            except (QueueOverflow, DeadlineExceeded) as e:
+                body = {"error": str(e)}
+                tier = getattr(e, "tier", None)
+                if tier is not None:
+                    body["brownout"] = {
+                        "level": getattr(e, "level", None), "tier": tier,
+                    }
+                return 429, body
+            except Exception as e:  # noqa: BLE001 — the contract under test
+                return 500, {"error": repr(e)}
+
+        load = OpenLoopLoadGen(
+            load_fn, rate_hz=60.0, duration_s=1.0, budget_s=0.25, workers=8,
+        ).run()
+        out["load"] = {
+            "offered": load["offered"], "completed": load["completed"],
+            "n_5xx": load["n_5xx"],
+            "transport_errors": load["transport_errors"],
+            "parity_ok": load["parity_ok"],
+            "p99_s": load["latency_s"]["p99"],
+            "brownout_tiers_seen": load["brownout_tiers_seen"],
+        }
+        if load["n_5xx"] or load["transport_errors"] or not load["parity_ok"]:
+            out["rc"] = 1
+            out["error"] = f"under-load leg broke the overload contract: {out['load']}"
     finally:
         service.close()
     return out

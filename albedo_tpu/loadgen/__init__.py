@@ -1,0 +1,5 @@
+"""Open-loop load harness for the serving plane (see ``generator``)."""
+
+from albedo_tpu.loadgen.generator import OpenLoopLoadGen, percentiles
+
+__all__ = ["OpenLoopLoadGen", "percentiles"]

@@ -1,0 +1,208 @@
+"""Open-loop load generation for the serving plane.
+
+The central methodological point: the generator is **open loop**. Ticks are
+scheduled on a fixed grid (``t0 + i / rate_hz``) regardless of how fast the
+service answers, and every request's latency is measured from its SCHEDULED
+tick time — not from the moment a worker got around to sending it. A
+closed-loop client (send, wait, send again) silently throttles itself to the
+service's capacity and reports flattering latencies exactly when the service
+is drowning; an open-loop one keeps offering load, so standing queues and
+coordinated omission show up in p99/p999 where they belong.
+
+Mechanics:
+
+- a single pacer thread (``albedo-loadgen-pacer``) sleeps to each grid tick
+  and enqueues the tick index onto an unbounded dispatch queue;
+- a pool of worker threads (each named ``albedo-loadgen-worker``) drains the
+  queue and calls ``request_fn(i)``, which returns ``(status, info)`` —
+  ``status`` is an HTTP-style integer, ``info`` an optional dict whose
+  ``{"brownout": {"tier": ...}}`` shape (the serving plane's degrade tag) is
+  aggregated into the report;
+- results accumulate under ``named_lock("loadgen.results")``; the report is
+  computed after both the pacer and every worker have been joined.
+
+Size ``workers`` above ``rate_hz * expected_p99_s`` — with fewer, the worker
+pool itself becomes the bottleneck and the harness degenerates toward closed
+loop (the backlog still shows up in the scheduled-time latencies, so the
+numbers stay honest, but they then measure the client, not the service).
+
+Each tick passes the ``loadgen.tick`` fault site. An armed ``error`` there
+drops the tick before dispatch (counted as ``ticks_dropped``) — chaos runs
+use it to punch deterministic holes in the offered load and assert the
+parity accounting (offered == completed + dropped) survives them.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+import time
+
+import numpy as np
+
+from albedo_tpu.analysis.locksmith import named_lock, note_access
+from albedo_tpu.utils import faults
+
+log = logging.getLogger(__name__)
+
+_TICK_FAULT = faults.site("loadgen.tick")
+
+# One pool sentinel per worker, enqueued only after the pacer has been
+# joined — a worker that sees it knows the grid is exhausted.
+_STOP = object()
+
+
+def percentiles(values, qs=(50.0, 99.0, 99.9)) -> dict[str, float | None]:
+    """``{"p50": ..., "p99": ..., "p999": ...}`` (None when empty)."""
+    labels = ["p" + str(q).rstrip("0").rstrip(".").replace(".", "") for q in qs]
+    if len(values) == 0:
+        return {lab: None for lab in labels}
+    pts = np.percentile(np.asarray(values, dtype=np.float64), list(qs))
+    return {lab: float(v) for lab, v in zip(labels, pts)}
+
+
+class OpenLoopLoadGen:
+    """Constant-rate open-loop generator around a ``request_fn``.
+
+    ``request_fn(i) -> (status, info)`` performs one request (over HTTP or
+    in-process) and must never raise for ordinary service-side failures —
+    it translates them into a status code. A raise is recorded as a
+    transport error (status 0), kept distinct from server 5xx in the
+    report.
+    """
+
+    def __init__(
+        self,
+        request_fn,
+        rate_hz: float,
+        duration_s: float,
+        budget_s: float = 0.25,
+        workers: int = 8,
+        clock=time.monotonic,
+    ):
+        if rate_hz <= 0:
+            raise ValueError(f"rate_hz must be positive, got {rate_hz}")
+        self.request_fn = request_fn
+        self.rate_hz = float(rate_hz)
+        self.duration_s = float(duration_s)
+        self.budget_s = float(budget_s)
+        self.workers = max(1, int(workers))
+        self._clock = clock
+        self._dispatch: queue.Queue = queue.Queue()
+        # Guards every mutable field below — workers and the pacer write
+        # concurrently; run() reads only after joining all of them.
+        self._lock = named_lock("loadgen.results")
+        self._results: list[tuple[int, float, int, str | None]] = []
+        self._transport_errors = 0
+        self._ticks_dropped = 0
+
+    # ------------------------------------------------------------- threads
+
+    def _pace(self, t0: float, n_ticks: int) -> None:
+        for i in range(n_ticks):
+            target = t0 + i / self.rate_hz
+            delay = target - self._clock()
+            if delay > 0:
+                time.sleep(delay)
+            try:
+                _TICK_FAULT.hit()
+            except Exception:  # noqa: BLE001 — armed tick fault: drop the tick
+                with self._lock:
+                    note_access("loadgen.results_state", write=True, owner=self)
+                    self._ticks_dropped += 1
+                continue
+            self._dispatch.put((i, target))
+
+    def _work(self) -> None:
+        while True:
+            item = self._dispatch.get()
+            if item is _STOP:
+                return
+            i, scheduled = item
+            tier = None
+            try:
+                status, info = self.request_fn(i)
+                if isinstance(info, dict):
+                    brown = info.get("brownout")
+                    if isinstance(brown, dict):
+                        tier = brown.get("tier")
+            except Exception as e:  # noqa: BLE001 — transport failure, not a 5xx
+                log.debug("loadgen request %d transport error: %s", i, e)
+                status = 0
+            latency = self._clock() - scheduled  # open loop: from the GRID tick
+            with self._lock:
+                note_access("loadgen.results_state", write=True, owner=self)
+                if status == 0:
+                    self._transport_errors += 1
+                self._results.append((i, latency, int(status), tier))
+
+    # ----------------------------------------------------------------- run
+
+    def run(self) -> dict:
+        """Offer the full grid, drain it, and return the aggregate report."""
+        n_ticks = max(1, int(round(self.rate_hz * self.duration_s)))
+        pool = [
+            threading.Thread(
+                target=self._work, name="albedo-loadgen-worker", daemon=True
+            )
+            for _ in range(self.workers)
+        ]
+        for t in pool:
+            t.start()
+        pacer = threading.Thread(
+            target=self._pace,
+            args=(self._clock(), n_ticks),
+            name="albedo-loadgen-pacer",
+            daemon=True,
+        )
+        pacer.start()
+        pacer.join()
+        for _ in pool:
+            self._dispatch.put(_STOP)
+        for t in pool:
+            t.join()
+        return self._report(n_ticks)
+
+    def _report(self, n_ticks: int) -> dict:
+        with self._lock:
+            note_access("loadgen.results_state", owner=self)
+            results = list(self._results)
+            dropped = self._ticks_dropped
+            transport = self._transport_errors
+        lat_all = [r[1] for r in results]
+        lat_ok = [r[1] for r in results if 200 <= r[2] < 300]
+        status_counts: dict[str, int] = {}
+        for _, _, status, _ in results:
+            key = str(status)
+            status_counts[key] = status_counts.get(key, 0) + 1
+        n_5xx = sum(v for k, v in status_counts.items() if k.startswith("5"))
+        n_ok = len(lat_ok)
+        attained = sum(1 for v in lat_ok if v <= self.budget_s)
+        tiers = sorted({r[3] for r in results if r[3]})
+        return {
+            "mode": "open_loop",
+            "rate_hz": self.rate_hz,
+            "duration_s": self.duration_s,
+            "workers": self.workers,
+            "offered": n_ticks,
+            "ticks_dropped": dropped,
+            "completed": len(results),
+            "parity_ok": n_ticks == len(results) + dropped,
+            "status_counts": status_counts,
+            "n_5xx": n_5xx,
+            "transport_errors": transport,
+            "latency_s": dict(
+                percentiles(lat_all),
+                max=(float(max(lat_all)) if lat_all else None),
+            ),
+            "success_latency_s": percentiles(lat_ok),
+            "slo": {
+                "budget_s": self.budget_s,
+                # Attainment over OFFERED load: a shed or dropped request
+                # cannot attain the SLO — that is the point of open loop.
+                "attainment": (attained / n_ticks) if n_ticks else 0.0,
+                "success_attainment": (attained / n_ok) if n_ok else None,
+            },
+            "brownout_tiers_seen": tiers,
+        }

@@ -108,9 +108,12 @@ class BankStage:
         fusion-ready shape ``recommenders.base`` produces, from one device
         pass. ``sources`` restricts the pass (the pipeline excludes names
         its generation snapshot already answers — a bank frame must never
-        clobber the snapshot's)."""
+        clobber the snapshot's). ``k`` overrides the stage's ``top_k`` —
+        the brownout ladder's reduced-k tier passes its halved budget here;
+        it is clamped to >= 1 so an aggressively-degraded request can never
+        drive the device pass with an empty shape."""
         bank = self._bank  # snapshot: a concurrent reload must not tear us
-        k = self.top_k if k is None else int(k)
+        k = max(1, self.top_k if k is None else int(k))
         dense = self.matrix.users_of(np.asarray([int(user_id)], dtype=np.int64))
         # Filter against the SNAPSHOTTED bank — source_names reads the live
         # one, and a mid-request promote that adds a source would otherwise

@@ -32,7 +32,15 @@ Mechanics:
 - Bounded queue: ``submit`` on a full queue raises :class:`QueueOverflow`
   (the HTTP layer turns it into a 429) instead of letting latency collapse;
   the exception carries a ``Retry-After`` estimate priced from queue depth
-  at the observed (EWMA) batch latency.
+  at the observed (EWMA) batch latency — and, when an
+  :class:`~albedo_tpu.serving.overload.OverloadController` is attached,
+  scaled by the current adaptive admission limit and brownout level.
+- Adaptive admission (``overload=``): before the static queue bound ever
+  matters, each submit consults the controller's AIMD concurrency limit
+  (grown/shrunk from observed batch latency vs the SLO) and the brownout
+  ladder's shed tier; the worker feeds batch latency + head-of-queue
+  sojourn back after every executed batch, and sheds the oldest-lapsed
+  queued work first under the CoDel control law when standing delay builds.
 - Deadline-aware admission control: a request submitted with a ``deadline``
   that lapses while it queues is shed (:class:`DeadlineExceeded`, also a
   429) before the worker spends a device batch on it — under overload the
@@ -63,6 +71,7 @@ import numpy as np
 from albedo_tpu.analysis.locksmith import named_lock, note_access
 from albedo_tpu.models.als import ALSModel
 from albedo_tpu.ops.topk import topk_scores
+from albedo_tpu.serving.overload import tier_name
 from albedo_tpu.utils import pow2_at_least as _pow2_bucket
 from albedo_tpu.utils.aot import persistent_aot_executable
 
@@ -74,12 +83,22 @@ class QueueOverflow(RuntimeError):
 
     ``retry_after_s`` (when set) is the batcher's estimate of when capacity
     returns — queue depth priced at the observed batch latency — which the
-    HTTP layer surfaces as the 429's ``Retry-After`` header.
+    HTTP layer surfaces as the 429's ``Retry-After`` header. ``tier`` /
+    ``level`` carry the brownout ladder position that shed the request (when
+    the overload layer did), so the 429 body can tag the degradation tier.
     """
 
-    def __init__(self, message: str, retry_after_s: float | None = None):
+    def __init__(
+        self,
+        message: str,
+        retry_after_s: float | None = None,
+        tier: str | None = None,
+        level: int | None = None,
+    ):
         super().__init__(message)
         self.retry_after_s = retry_after_s
+        self.tier = tier
+        self.level = level
 
 
 class DeadlineExceeded(QueueOverflow):
@@ -129,6 +148,9 @@ class _Request:
     # Admission control: monotonic deadline; the worker sheds the request
     # instead of computing it if the deadline passes while it queues.
     deadline: float | None = None
+    # Monotonic enqueue timestamp: the CoDel discipline sheds on the oldest
+    # request's sojourn, and the worker reports head-of-queue wait per batch.
+    enqueued_at: float = 0.0
 
 
 _SENTINEL = object()
@@ -167,6 +189,7 @@ class MicroBatcher:
         max_queue: int = 256,
         window_ms: float = 2.0,
         metrics=None,
+        overload=None,
     ):
         self.model = model
         # Device-side exclusion: the full -1-padded seen-item table uploaded
@@ -184,6 +207,9 @@ class MicroBatcher:
         self.max_batch = max(1, _pow2_bucket(max_batch))
         self.window_s = float(window_ms) / 1e3
         self.metrics = metrics
+        # Optional serving.overload.OverloadController — shared across model
+        # generations by the service so hot swaps inherit brownout state.
+        self._overload = overload
         self._uf, self._vf = model.device_factors()
         self._n_users = int(self._uf.shape[0])
         self._queue: "queue.Queue[_Request | object]" = queue.Queue(maxsize=max_queue)
@@ -220,14 +246,21 @@ class MicroBatcher:
 
     def retry_after_s(self) -> float:
         """When should a shed client come back? Queue depth priced in batches
-        at the observed batch latency, clamped to [1, 30] seconds — an
-        estimate for the 429 ``Retry-After`` header, not a promise."""
+        at the observed batch latency — then scaled by the overload layer's
+        current admission limit and brownout level (depth x EWMA alone
+        under-prices a browned-out service: the queue looks short precisely
+        BECAUSE the adaptive limit shrank, and honest backoff has to reflect
+        that). Clamped to [1, 30] seconds — an estimate for the 429
+        ``Retry-After`` header, not a promise."""
         depth = self._queue.qsize()
         batches_ahead = depth / self.max_batch + 1.0
         with self._stats_lock:
             note_access("serving.batcher.stats_state", owner=self)
             ewma = self._ewma_batch_s
-        return float(min(30.0, max(1.0, batches_ahead * ewma)))
+        base = batches_ahead * ewma
+        if self._overload is not None:
+            base = self._overload.price_retry_after(base, depth)
+        return float(min(30.0, max(1.0, base)))
 
     def submit(
         self,
@@ -260,8 +293,29 @@ class MicroBatcher:
             raise IndexError(
                 f"user index out of range [0, {self._n_users}): {dense_user}"
             )
+        if self._overload is not None and not self._overload.admit(
+            self._queue.qsize()
+        ):
+            # Adaptive admission shed: over the AIMD limit, at the ladder's
+            # shed tier, or a forced serving.admit fault — a 429 with honest
+            # pricing, never a 5xx. (The controller counts the per-tier shed.)
+            if self.metrics is not None:
+                self.metrics.shed.inc()
+            # Read the level ONCE and derive the tier from it — two separate
+            # reads can straddle a ladder transition and tag an incoherent
+            # (tier, level) pair.
+            lvl = self._overload.brownout_level
+            raise QueueOverflow(
+                "admission limit reached (adaptive overload control)",
+                retry_after_s=self.retry_after_s(),
+                tier=tier_name(lvl),
+                level=lvl,
+            )
         fut: Future = Future()
-        req = _Request(int(dense_user), int(k), exclude, fut, deadline=deadline)
+        req = _Request(
+            int(dense_user), int(k), exclude, fut,
+            deadline=deadline, enqueued_at=time.monotonic(),
+        )
         try:
             with self._submit_lock:
                 if self._closed:
@@ -270,9 +324,17 @@ class MicroBatcher:
         except queue.Full:
             if self.metrics is not None:
                 self.metrics.shed.inc()
+            if self._overload is not None:
+                self._overload.count_shed()
+            lvl = (
+                self._overload.brownout_level
+                if self._overload is not None else None
+            )
             raise QueueOverflow(
                 f"serving queue full ({self._queue.maxsize} waiting)",
                 retry_after_s=self.retry_after_s(),
+                tier=tier_name(lvl) if lvl is not None else None,
+                level=lvl,
             ) from None
         return fut
 
@@ -349,6 +411,10 @@ class MicroBatcher:
             except queue.Empty:
                 if self._stop.is_set():
                     return
+                if self._overload is not None:
+                    # An empty queue is calm evidence: it lets the brownout
+                    # ladder walk back down even when traffic stops entirely.
+                    self._overload.idle_tick()
                 continue
             if first is _SENTINEL:
                 if self._stop.is_set() and self._queue.empty():
@@ -378,6 +444,7 @@ class MicroBatcher:
                     _resolve(req.future, exc=BatcherClosed("batcher shut down"))
                 continue
             batch = self._shed_expired(batch)
+            batch = self._codel_shed(batch)
             if not batch:
                 continue
             groups: dict[tuple[int, str], list[_Request]] = {}
@@ -417,6 +484,40 @@ class MicroBatcher:
             else:
                 live.append(req)
         return live
+
+    def _codel_shed(self, batch: list) -> list:
+        """CoDel queue discipline: when the OLDEST collected request's
+        sojourn has stayed over target for a full interval, shed the
+        oldest-lapsed work first at the ``interval/sqrt(count)`` cadence —
+        standing queue delay drains instead of being served stale."""
+        if self._overload is None or not batch:
+            return batch
+        # Classic CoDel exits dropping when the queue drains: a batch that
+        # absorbed the whole queue IS the queue — its head sojourn is
+        # batching + service latency, not standing delay, however slow the
+        # box. Only a backlog the batch could not absorb engages the law;
+        # the drained path feeds a zero sojourn so the controller resets.
+        if self._queue.qsize() == 0 and len(batch) < self.max_batch:
+            self._overload.codel_shed(0.0)
+            return batch
+        now = time.monotonic()
+        while batch:
+            head = min(batch, key=lambda r: r.enqueued_at)
+            if not head.enqueued_at:
+                break
+            if not self._overload.codel_shed(now - head.enqueued_at):
+                break
+            batch.remove(head)
+            lvl = self._overload.brownout_level
+            if _resolve(head.future, exc=QueueOverflow(
+                "shed standing queue delay (CoDel)",
+                retry_after_s=self.retry_after_s(),
+                tier=tier_name(lvl),
+                level=lvl,
+            )):
+                if self.metrics is not None:
+                    self.metrics.shed.inc()
+        return batch
 
     def _drain_into(self, batch: list) -> None:
         while len(batch) < self.max_batch:
@@ -464,6 +565,9 @@ class MicroBatcher:
 
     def _execute(self, k: int, mode: str, reqs: list[_Request]) -> None:
         t0 = time.perf_counter()
+        # Same clock as _Request.enqueued_at — head-of-queue sojourn at the
+        # moment this batch started executing.
+        dequeued_at = time.monotonic()
         bucket = _pow2_bucket(len(reqs))
         user_idx = np.zeros(bucket, dtype=np.int32)
         for i, req in enumerate(reqs):
@@ -497,6 +601,12 @@ class MicroBatcher:
             self.batches_run += 1
             self.requests_served += len(reqs)
             self._ewma_batch_s += 0.2 * (batch_s - self._ewma_batch_s)
+        if self._overload is not None:
+            # Outside the stats lock: the controller takes its own locks and
+            # the pair would otherwise need a lock-order catalog entry.
+            stamps = [r.enqueued_at for r in reqs if r.enqueued_at]
+            head_wait = max(0.0, dequeued_at - min(stamps)) if stamps else 0.0
+            self._overload.observe_batch(batch_s, head_wait)
         if self.metrics is not None:
             self.metrics.batch_size.observe(len(reqs))
             self.metrics.batch_latency.observe(batch_s)

@@ -132,14 +132,21 @@ def _make_handler(service: RecommendationService):
                 code = 400
                 self._json({"error": str(e)}, code=400)
             except QueueOverflow as e:
-                # Load shedding (queue overflow or deadline shed): the
-                # bounded queue protects latency; tell the client when to
-                # come back — priced from the batcher's throughput — instead
-                # of letting it hang.
+                # Load shedding (queue overflow, deadline shed, adaptive
+                # admission, or the brownout shed tier): tell the client when
+                # to come back — priced from throughput, the adaptive limit,
+                # and the brownout level — and WHICH tier shed it, instead of
+                # letting it hang. A 429 here is the overload design working.
                 code = 429
                 retry_after = getattr(e, "retry_after_s", None) or 1.0
+                body = {"error": str(e)}
+                tier = getattr(e, "tier", None)
+                if tier is not None:
+                    body["brownout"] = {
+                        "level": getattr(e, "level", None), "tier": tier,
+                    }
                 self._json(
-                    {"error": str(e)}, code=429,
+                    body, code=429,
                     extra={"Retry-After": str(max(1, round(retry_after)))},
                 )
             except BatcherClosed:

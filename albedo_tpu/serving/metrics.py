@@ -104,6 +104,20 @@ class MetricsRegistry:
             "Circuit breaker state transitions, by source and new state.",
             ("source", "to"),
         )
+        # --- overload-resilience plane (serving/overload.py) ----------------
+        self.admission_limit = self.gauge(
+            events.ADMISSION_LIMIT,
+            "Current AIMD adaptive admission limit (outstanding requests).",
+        )
+        self.brownout_level = self.gauge(
+            events.BROWNOUT_LEVEL,
+            "Brownout ladder level (0=full .. 4=shed).",
+        )
+        self.overload_shed = self.counter(
+            events.OVERLOAD_SHED_TOTAL,
+            "Requests shed by the overload layer, by active brownout tier.",
+            ("tier",),
+        )
         # No `_total` suffix: these render as TYPE gauge (set to absolute
         # Timer.snapshot values at scrape time) and Prometheus reserves
         # `_total` for counters — promtool flags the mismatch.

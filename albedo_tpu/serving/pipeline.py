@@ -52,6 +52,12 @@ from albedo_tpu.datasets.star_matrix import StarMatrix
 from albedo_tpu.recommenders.base import Recommender, fuse_candidates
 from albedo_tpu.serving.batcher import BatcherClosed, MicroBatcher
 from albedo_tpu.serving.breaker import STATE_VALUES, BreakerConfig, CircuitBreaker
+from albedo_tpu.serving.overload import (
+    LEVEL_BANK_ONLY,
+    LEVEL_CACHE_POPULARITY,
+    LEVEL_SKIP_RERANK,
+    tier_name,
+)
 from albedo_tpu.utils import faults
 from albedo_tpu.utils.profiling import Timer
 
@@ -240,6 +246,8 @@ class TwoStagePipeline:
         exclude_seen: bool = True,
         extra_sources: dict | None = None,
         deadline: float | None = None,
+        allowed: frozenset | None = None,
+        bank_k: int | None = None,
     ) -> dict[str, pd.DataFrame]:
         """Stage 1: every registered source in parallel, one shared deadline.
         ``exclude_seen`` reaches the sources that honor it (the ALS source);
@@ -249,7 +257,10 @@ class TwoStagePipeline:
         ``deadline`` (monotonic) caps the stage budget; a source cut short
         by the CLIENT's deadline (not its own stage budget) degrades but
         records no breaker outcome — the dependency wasn't given its full
-        chance, so its failure count must not move."""
+        chance, so its failure count must not move. ``allowed`` restricts
+        the fan-out to the named sources (the brownout ladder's bank-only /
+        popularity-only tiers); ``bank_k`` overrides the bank's per-source
+        k (the reduced-k tier)."""
         users = np.array([int(user_id)], dtype=np.int64)
 
         def call_source(name: str, rec: Recommender) -> pd.DataFrame:
@@ -264,6 +275,10 @@ class TwoStagePipeline:
             return rec.recommend_for_users(users)
 
         all_sources = self._sources(extra_sources)
+        if allowed is not None:
+            all_sources = {
+                n: rec for n, rec in all_sources.items() if n in allowed
+            }
         # Bank-resident sources skip the thread fan-out: ONE submitted task
         # answers all of them in a fused device pass. The generation-snapshot
         # ALS source (extra_sources) wins over a bank registration of the
@@ -276,13 +291,14 @@ class TwoStagePipeline:
             bank_names = [
                 n for n in bank.source_names
                 if not (extra_sources and n in extra_sources)
+                and (allowed is None or n in allowed)
             ]
             if bank_names:
                 # Restricted to bank_names: the stage may carry more sources
                 # (e.g. "als") than this request lets it serve — a bank
                 # frame must never clobber the generation snapshot's.
                 bank_fut = self._pool.submit(
-                    bank.query_frames, int(user_id), None, exclude_seen,
+                    bank.query_frames, int(user_id), bank_k, exclude_seen,
                     tuple(bank_names),
                 )
         futs: dict[str, Future] = {}
@@ -389,6 +405,7 @@ class TwoStagePipeline:
         exclude_seen: bool = True,
         extra_sources: dict | None = None,
         deadline: float | None = None,
+        brownout_level: int = 0,
     ) -> dict:
         """One online request: returns ``{stage, degraded, items}`` where each
         item is ``{repo_id, score, source}`` (score = LR probability on the
@@ -397,22 +414,50 @@ class TwoStagePipeline:
         service threads its generation-snapshot ALS source through here.
         ``deadline`` (client, monotonic) caps every stage budget so the
         response lands inside it, degrading per the matrix instead of
-        arriving late."""
+        arriving late. ``brownout_level`` (serving.overload ladder) degrades
+        the plan under sustained overload: >=1 skips the LR re-rank (raw
+        MIPS scores), >=2 halves k and restricts to bank-resident sources,
+        >=3 answers from popularity only (the cache already short-circuits
+        hot users upstream). Every browned-out response is tagged."""
         degraded: list[str] = []
+        allowed: frozenset | None = None
+        bank_k: int | None = None
+        skip_rank = False
+        if brownout_level >= LEVEL_SKIP_RERANK:
+            # Tag the ACTIVE tier (one tag, not one per implied level) and
+            # count it like any other degradation.
+            self._degrade(degraded, f"brownout_{tier_name(brownout_level)}")
+            skip_rank = self.ranker is not None
+            if brownout_level >= LEVEL_BANK_ONLY:
+                k = max(1, int(k) // 2)
+                if self.bank_stage is not None:
+                    allowed = frozenset(self.bank_stage.source_names) | {"als"}
+                    bank_k = k
+                else:
+                    allowed = frozenset({"als", "popularity"})
+            if brownout_level >= LEVEL_CACHE_POPULARITY:
+                allowed = frozenset({"popularity"})
         timer_section = self.timer.section
         with timer_section("stage1_candidates"):
             frames = self.candidates(
                 user_id, degraded, exclude_seen=exclude_seen,
                 extra_sources=extra_sources, deadline=deadline,
+                allowed=allowed, bank_k=bank_k,
             )
 
+        out_tags = {}
+        if brownout_level >= LEVEL_SKIP_RERANK:
+            out_tags = {
+                "brownout_level": int(brownout_level),
+                "brownout_tier": tier_name(brownout_level),
+            }
         order = [n for n in self._source_order(frames) if len(frames[n])]
         if not order:
-            return {"stage": "empty", "degraded": degraded, "items": []}
+            return {"stage": "empty", "degraded": degraded, "items": [], **out_tags}
         fused = fuse_candidates([frames[n] for n in order])
 
         ranked = None
-        if self.ranker is not None:
+        if self.ranker is not None and not skip_rank:
             rank_timeout = self.deadlines.ranker_s
             if deadline is not None:
                 rank_timeout = max(0.0, min(rank_timeout, deadline - time.monotonic()))
@@ -469,4 +514,4 @@ class TwoStagePipeline:
 
         # Stage gauges are refreshed from self.timer at /metrics scrape time
         # (http.py) — no per-request mirroring on the hot path.
-        return {"stage": stage, "degraded": degraded, "items": items}
+        return {"stage": stage, "degraded": degraded, "items": items, **out_tags}

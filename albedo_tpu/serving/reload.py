@@ -66,6 +66,7 @@ import numpy as np
 from albedo_tpu.analysis.locksmith import named_lock
 from albedo_tpu.datasets import artifacts as artifact_store
 from albedo_tpu.models.als import ALSModel
+from albedo_tpu.serving.overload import LEVEL_SHED
 from albedo_tpu.serving.service import ModelGeneration, RecommendationService
 from albedo_tpu.utils import events, faults
 
@@ -678,6 +679,16 @@ class HotSwapManager:
                 log.exception("reload watch iteration failed")
 
     def _watch_once(self) -> None:
+        overload = getattr(self.service, "overload", None)
+        if overload is not None and overload.brownout_level >= LEVEL_SHED:
+            # The ladder is at its shed tier: the service is rejecting work
+            # to survive, so don't also spend it on a watcher-initiated swap
+            # (two resident generations + a warm compile). The candidates
+            # stay unseen and the next sweep retries; an explicit
+            # /admin/reload or SIGHUP still runs — an operator may be
+            # swapping to FIX the overload.
+            log.warning("deferring artifact watch: brownout shed tier active")
+            return
         changed: list[tuple[Path, tuple[float, int]]] = []
         for p in self.candidate_paths():  # oldest -> newest
             st = p.stat()

@@ -47,9 +47,20 @@ from albedo_tpu.analysis.locksmith import named_lock
 from albedo_tpu.datasets.ragged import csr_row, padded_rows
 from albedo_tpu.datasets.star_matrix import StarMatrix
 from albedo_tpu.models.als import ALSModel
-from albedo_tpu.serving.batcher import BatcherClosed, DeadlineExceeded, MicroBatcher
+from albedo_tpu.serving.batcher import (
+    BatcherClosed,
+    DeadlineExceeded,
+    MicroBatcher,
+    QueueOverflow,
+)
 from albedo_tpu.serving.cache import TTLCache
 from albedo_tpu.serving.metrics import MetricsRegistry
+from albedo_tpu.serving.overload import (
+    LEVEL_SHED,
+    OverloadConfig,
+    OverloadController,
+    tier_name,
+)
 from albedo_tpu.serving.pipeline import (
     BatchedALSSource,
     StageDeadlines,
@@ -108,6 +119,8 @@ class RecommendationService:
         breaker_config=None,
         breakers_enabled: bool = True,
         bank_stage=None,  # retrieval.stage.BankStage — fused candidate stage
+        overload_enabled: bool = True,
+        overload_config: OverloadConfig | None = None,
     ):
         self.matrix = matrix
         self.repo_info = repo_info if repo_info is not None else pd.DataFrame()
@@ -126,6 +139,17 @@ class RecommendationService:
         self._batch_window_ms = float(batch_window_ms)
         self._warm = bool(warm)
         self.reload_manager = None  # set by serving.reload.HotSwapManager
+        # Overload-resilience layer (serving.overload): ONE controller for
+        # the whole service, shared by every generation's batcher, so a hot
+        # swap under pressure inherits the brownout state. The default AIMD
+        # ceiling is the legacy queue bound — an unstressed service behaves
+        # exactly like the static bounded queue it replaced.
+        self.overload: OverloadController | None = None
+        if overload_enabled:
+            self.overload = OverloadController(
+                overload_config or OverloadConfig(max_limit=int(max_queue)),
+                metrics=self.metrics,
+            )
 
         if matrix is not None:
             self._indptr, self._cols, _ = matrix.csr()
@@ -241,6 +265,7 @@ class RecommendationService:
                 max_queue=self._max_queue,
                 window_ms=self._batch_window_ms,
                 metrics=self.metrics,
+                overload=self.overload,
             )
             if warm:
                 batcher.warm(ks=(self.default_k,))
@@ -330,6 +355,8 @@ class RecommendationService:
             report["retrieval_bank"] = self.bank_stage.snapshot()
         if self.cache is not None:
             report["cache"] = self.cache.stats()
+        if self.overload is not None:
+            report["overload"] = self.overload.snapshot()
         return ready, report
 
     # ----------------------------------------------------------- lifecycle
@@ -519,7 +546,13 @@ class RecommendationService:
             key = cache_key(gen)
             status, body = self._compute(gen, user_id, k, exclude_seen, deadline)
         self.metrics.generation_requests.inc(generation=str(gen.number))
-        if self.cache is not None and status == 200 and not body.get("degraded"):
+        if (
+            self.cache is not None and status == 200
+            and not body.get("degraded") and not body.get("brownout")
+        ):
+            # Degraded OR brownout-tagged bodies never enter the cache: a
+            # reduced-quality answer must not outlive the incident (the TTL
+            # cache is what the cache_popularity tier leans on for quality).
             self.cache.put(key, (status, body), user_id=user_id)
         return status, body
 
@@ -544,6 +577,25 @@ class RecommendationService:
                     gen.batcher.retry_after_s() if gen.batcher is not None else None
                 ),
             )
+        # Brownout ladder, every path: at the shed tier nothing is computed —
+        # a 429 with honest Retry-After pricing, tagged with the tier, never
+        # a 5xx. Below it the level degrades the pipeline plan instead.
+        blevel = 0
+        if self.overload is not None:
+            blevel = self.overload.brownout_level
+            if blevel >= LEVEL_SHED:
+                self.overload.count_shed()
+                self.metrics.shed.inc()
+                raise QueueOverflow(
+                    "brownout shed tier active",
+                    retry_after_s=(
+                        gen.batcher.retry_after_s()
+                        if gen.batcher is not None
+                        else self.overload.price_retry_after(1.0, 0)
+                    ),
+                    tier=tier_name(blevel),
+                    level=blevel,
+                )
         # Cold/missing ALS artifacts: the popularity fallback keeps answering.
         # The degraded counter counts ANSWERED degraded requests only — the
         # no-fallback 503 below is an error, not a degradation.
@@ -560,7 +612,8 @@ class RecommendationService:
                 }
             self.metrics.degraded.inc(reason="cold_artifacts")
             out = self.pipeline.recommend(
-                user_id, k, exclude_seen=exclude_seen, deadline=deadline
+                user_id, k, exclude_seen=exclude_seen, deadline=deadline,
+                brownout_level=blevel,
             )
             out.setdefault("degraded", []).insert(0, "cold_artifacts")
             return 200, self._pipeline_body(gen, user_id, k, out)
@@ -569,7 +622,7 @@ class RecommendationService:
             extra = {"als": gen.als_source} if gen.als_source is not None else None
             out = self.pipeline.recommend(
                 user_id, k, exclude_seen=exclude_seen, extra_sources=extra,
-                deadline=deadline,
+                deadline=deadline, brownout_level=blevel,
             )
             return 200, self._pipeline_body(gen, user_id, k, out)
 
@@ -577,11 +630,18 @@ class RecommendationService:
             body = self._recommend_batched(gen, user_id, k, exclude_seen, deadline)
         else:
             body = self.recommend(user_id, k=k, exclude_seen=exclude_seen)
+        if blevel > 0 and self.overload is not None and not body.get("error"):
+            # No pipeline to degrade — the plain path answers at full quality
+            # until the shed tier, but the response still carries the tier
+            # tag so clients and the harness see the brownout state.
+            body["brownout"] = {
+                "level": blevel, "tier": tier_name(blevel),
+            }
         return (404 if body.get("error") else 200), body
 
     def _pipeline_body(self, gen: ModelGeneration, user_id: int, k: int, out: dict) -> dict:
         items = out.get("items", [])
-        return {
+        body = {
             "user_id": user_id,
             "k": k,
             "generation": gen.number,
@@ -592,6 +652,12 @@ class RecommendationService:
                 for item in items
             ],
         }
+        if out.get("brownout_level"):
+            body["brownout"] = {
+                "level": out["brownout_level"],
+                "tier": out.get("brownout_tier"),
+            }
+        return body
 
     # -------------------------------------------------------- admin search
 

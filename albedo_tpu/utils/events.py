@@ -238,6 +238,11 @@ SCORE_USERS_TOTAL = "albedo_score_users_total"
 SCORE_SHARDS_TOTAL = "albedo_score_shards_total"
 SCORE_PUBLISH_REJECTED_TOTAL = "albedo_score_publish_rejected_total"
 
+# Overload-resilience plane (serving/overload.py, PR 20).
+BROWNOUT_LEVEL = "albedo_brownout_level"
+OVERLOAD_SHED_TOTAL = "albedo_overload_shed_total"
+ADMISSION_LIMIT = "albedo_admission_limit"
+
 METRIC_NAMES: frozenset = frozenset(
     v for k, v in list(globals().items())
     if k.isupper() and isinstance(v, str) and v.startswith("albedo_")

@@ -48,7 +48,8 @@ code by ``tests/test_fault_sites.py``): ``artifact.load``,
 ``crawler.transport``, ``pipeline.stage``, ``pipeline.stage.<name>``,
 ``serving.source.<name>``, ``serving.rank``, ``serving.breaker.<name>``,
 ``reload.load``, ``reload.validate``, ``capacity.admit``, ``mesh.devices``,
-``als.chunked``, ``als.shard.collective``.
+``als.chunked``, ``als.shard.collective``, ``serving.admit``,
+``loadgen.tick``.
 """
 
 from __future__ import annotations

@@ -1308,6 +1308,311 @@ def serving_bench() -> dict:
     return record
 
 
+def overload_bench() -> dict:
+    """The `overload` scenario: the serving bench's overload-resilience leg.
+
+    A sustained OPEN-LOOP run (``albedo_tpu.loadgen``) against the full
+    pipeline-backed engine, offered at >= 2x measured capacity, with the
+    chaos legs fired *under* that load: validated hot-swap promotion, bank
+    reshard (device-degrade), streaming fold-in ``publish_user_rows``, and
+    a forced breaker trip. The record (SERVING_r02.json, env override
+    ALBEDO_SERVING_OUT) asserts the PR-20 overload contract:
+
+    - the surge never produces a 5xx (shed = 429 with Retry-After, degrade
+      = tagged 200);
+    - the brownout ladder engages during the surge and fully recovers to
+      level 0 after it;
+    - p999 stays bounded while shedding (open-loop latency from the
+      SCHEDULED tick, so standing queues are visible);
+    - every chaos leg completes, and request parity holds — every offered
+      tick is accounted as completed or deliberately dropped.
+
+    Env knobs: ALBEDO_OVERLOAD_USERS/ITEMS/SURGE_S/SLO/WORKERS/P999_BOUND.
+    """
+    import threading as _threading
+
+    from albedo_tpu.datasets import synthetic_tables
+    from albedo_tpu.datasets.artifacts import (
+        artifact_path,
+        manifest_path,
+        save_pickle,
+        write_manifest,
+    )
+    from albedo_tpu.datasets.ragged import padded_rows
+    from albedo_tpu.datasets.tables import popular_repos
+    from albedo_tpu.loadgen import OpenLoopLoadGen
+    from albedo_tpu.models.als import ImplicitALS
+    from albedo_tpu.recommenders import ALSRecommender, PopularityRecommender
+    from albedo_tpu.retrieval import BankStage, RetrievalBank
+    from albedo_tpu.serving import (
+        HotSwapManager,
+        QueueOverflow,
+        RecommendationService,
+    )
+    from albedo_tpu.serving.batcher import DeadlineExceeded
+    from albedo_tpu.serving.overload import OverloadConfig
+    from albedo_tpu.utils import faults
+
+    n_users = int(os.environ.get("ALBEDO_OVERLOAD_USERS", "1500"))
+    n_items = int(os.environ.get("ALBEDO_OVERLOAD_ITEMS", "1000"))
+    surge_s = float(os.environ.get("ALBEDO_OVERLOAD_SURGE_S", "6"))
+    slo_s = float(os.environ.get("ALBEDO_OVERLOAD_SLO", "0.02"))
+    workers = int(os.environ.get("ALBEDO_OVERLOAD_WORKERS", "96"))
+    p999_bound_s = float(os.environ.get("ALBEDO_OVERLOAD_P999_BOUND", "10"))
+    k = 20
+
+    tables = synthetic_tables(
+        n_users=n_users, n_items=n_items, mean_stars=8, seed=42
+    )
+    matrix = tables.star_matrix()
+    model = ImplicitALS(rank=16, max_iter=3, seed=0).fit(matrix)
+    als = ALSRecommender(model, matrix, exclude_seen=True, top_k=k)
+    pop = PopularityRecommender(
+        popular_repos(tables.repo_info, 1, 10**9), top_k=k
+    )
+    indptr, cols, _ = matrix.csr()
+    excl = padded_rows(indptr, cols, np.arange(matrix.n_users))
+    bank = RetrievalBank()
+    bank.register(als.bank_registration())
+    bank.build(matrix=matrix, exclude_table=excl)
+    stage = BankStage(bank, matrix, fallbacks={"als": als}, top_k=k)
+
+    # Tightened-for-smoke overload config: the generous defaults are tuned
+    # for production latencies; the CPU smoke needs the ladder to traverse
+    # its full range inside a ~15 s run.
+    cfg = OverloadConfig(
+        slo_s=slo_s, min_limit=2, max_limit=64,
+        engage_after=2, dwell_s=0.2, recovery_window_s=1.0,
+    )
+    service = RecommendationService(
+        model, matrix, repo_info=tables.repo_info,
+        recommenders={"popularity": pop}, bank_stage=stage,
+        batching=True, batch_window_ms=1.0, max_queue=64, warm=True,
+        overload_config=cfg,
+    )
+    user_ids = matrix.user_ids
+    rng = np.random.default_rng(2026)
+    uid_seq = rng.integers(0, len(user_ids), size=1 << 14)
+
+    def request_fn(i: int):
+        """In-process request with the HTTP layer's exact status mapping:
+        QueueOverflow/DeadlineExceeded -> 429 (+ brownout tag when the
+        ladder priced the shed), anything else unexpected -> 500."""
+        uid = int(user_ids[int(uid_seq[i % len(uid_seq)])])
+        try:
+            return service.handle_recommend(uid, k=k)
+        except (QueueOverflow, DeadlineExceeded) as e:
+            body = {"error": str(e)}
+            tier = getattr(e, "tier", None)
+            if tier is not None:
+                body["brownout"] = {
+                    "level": getattr(e, "level", None), "tier": tier,
+                }
+            return 429, body
+        except Exception as e:  # noqa: BLE001 — the contract under test
+            return 500, {"error": repr(e)}
+
+    record: dict = {
+        "metric": "serving_overload_resilience",
+        **hardware_fields(),
+        "unit": "checks",
+        "n_users": n_users,
+        "n_items": n_items,
+        "k": k,
+        "slo_s": slo_s,
+        "overload_config": {
+            "min_limit": cfg.min_limit, "max_limit": cfg.max_limit,
+            "engage_after": cfg.engage_after, "dwell_s": cfg.dwell_s,
+            "recovery_window_s": cfg.recovery_window_s,
+        },
+    }
+    chaos: dict = {}
+    swap_path = artifact_path("bench-overload-alsModel.pkl")
+    try:
+        # --- capacity calibration (closed loop, so it cannot overload) ----
+        stop = _threading.Event()
+        counts = [0] * 8
+
+        def calibration_client(ci: int) -> None:
+            crng = np.random.default_rng(100 + ci)
+            while not stop.is_set():
+                uid = int(user_ids[int(crng.integers(0, len(user_ids)))])
+                try:
+                    service.handle_recommend(uid, k=k)
+                except (QueueOverflow, DeadlineExceeded):
+                    pass
+                counts[ci] += 1
+
+        cal_threads = [
+            _threading.Thread(
+                target=calibration_client, args=(ci,),
+                name="bench-overload-calibrate", daemon=True,
+            )
+            for ci in range(len(counts))
+        ]
+        cal_s = 1.5
+        t0 = time.perf_counter()
+        for t in cal_threads:
+            t.start()
+        time.sleep(cal_s)
+        stop.set()
+        for t in cal_threads:
+            t.join(timeout=30)
+        capacity_rps = sum(counts) / (time.perf_counter() - t0)
+        record["capacity_rps"] = round(capacity_rps, 1)
+        # Calibration itself may have tripped the ladder; start the surge
+        # from a clean slate so "engaged" is attributable to the surge.
+        time.sleep(cfg.recovery_window_s * 5)
+        record["level_before_surge"] = service.overload.brownout_level
+
+        # --- the surge: open loop at >= 2x capacity + chaos legs ----------
+        surge_rate = max(2.0 * capacity_rps, 10.0)
+        record["surge_rate_hz"] = round(surge_rate, 1)
+        level_seen: list[int] = []
+        sampler_stop = _threading.Event()
+
+        def sample_levels() -> None:
+            while not sampler_stop.is_set():
+                level_seen.append(service.overload.brownout_level)
+                time.sleep(0.05)
+
+        sampler = _threading.Thread(
+            target=sample_levels, name="bench-overload-sampler", daemon=True
+        )
+        sampler.start()
+
+        save_pickle(swap_path, model.to_arrays())
+        write_manifest(swap_path)
+        mgr = HotSwapManager(service, probe_users=8, probe_k=k)
+
+        def leg(name: str, fn) -> None:
+            t0s = time.perf_counter()
+            try:
+                chaos[name] = {
+                    "result": fn(),
+                    "seconds": round(time.perf_counter() - t0s, 3),
+                }
+            except Exception as e:  # noqa: BLE001 — a failed leg fails checks
+                chaos[name] = {"error": repr(e)}
+
+        foldin_ids = np.arange(min(8, matrix.n_users), dtype=np.int64)
+        foldin_rows = np.asarray(
+            bank.specs["als"].user_vectors[foldin_ids], dtype=np.float32
+        )
+        overlay_before = bank.overlay_generation
+        timers = [
+            _threading.Timer(surge_s * 0.20, leg, args=(
+                "hot_swap",
+                lambda: mgr.request_reload(swap_path.resolve()),
+            )),
+            _threading.Timer(surge_s * 0.40, leg, args=(
+                "reshard",
+                lambda: stage.reshard(None),
+            )),
+            _threading.Timer(surge_s * 0.55, leg, args=(
+                "foldin_publish",
+                lambda: {"overlay_generation": stage.publish_user_rows(
+                    "als", foldin_ids, foldin_rows)},
+            )),
+            _threading.Timer(surge_s * 0.70, leg, args=(
+                "breaker_trip",
+                lambda: {"armed": bool(
+                    faults.arm("serving.breaker.popularity", "error", at=1, times=5)
+                )},
+            )),
+        ]
+        for t in timers:
+            t.start()
+        surge = OpenLoopLoadGen(
+            request_fn, rate_hz=surge_rate, duration_s=surge_s,
+            budget_s=slo_s, workers=workers,
+        ).run()
+        for t in timers:
+            t.join(timeout=120)
+        record["surge"] = surge
+        chaos["breaker_trip"] = dict(
+            chaos.get("breaker_trip", {}),
+            fired=faults.FAULTS.fired("serving.breaker.popularity"),
+        )
+        faults.disarm("serving.breaker.popularity")
+
+        # --- recovery: light load, then let the ladder decay to 0 ---------
+        light = OpenLoopLoadGen(
+            request_fn, rate_hz=max(2.0, 0.3 * capacity_rps),
+            duration_s=3.0, budget_s=slo_s, workers=8,
+        ).run()
+        sampler_stop.set()
+        sampler.join(timeout=10)
+        time.sleep(cfg.recovery_window_s * 5)
+        record["recovery"] = light
+        record["brownout_level_max"] = max(level_seen, default=0)
+        record["brownout_level_final"] = service.overload.brownout_level
+        record["admission_limit_final"] = service.overload.snapshot()[
+            "admission_limit"
+        ]
+        record["breaker_states"] = (
+            service.pipeline.breaker_states() if service.pipeline else {}
+        )
+        record["chaos"] = chaos
+
+        checks = {
+            "no_5xx": (
+                surge["n_5xx"] == 0 and light["n_5xx"] == 0
+                and surge["transport_errors"] == 0
+                and light["transport_errors"] == 0
+            ),
+            "offered_2x_capacity": surge_rate >= 2.0 * capacity_rps,
+            "brownout_engaged": record["brownout_level_max"] > 0,
+            "brownout_recovered": record["brownout_level_final"] == 0,
+            "p999_bounded": (
+                surge["latency_s"]["p999"] is not None
+                and surge["latency_s"]["p999"] <= p999_bound_s
+            ),
+            "hot_swap_promoted": (
+                chaos.get("hot_swap", {}).get("result", {}).get("outcome")
+                == "promoted"
+            ),
+            "resharded": (
+                chaos.get("reshard", {}).get("result", {}).get("outcome")
+                == "resharded"
+            ),
+            "foldin_published": (
+                chaos.get("foldin_publish", {}).get("result", {}).get(
+                    "overlay_generation", overlay_before
+                ) > overlay_before
+            ),
+            "breaker_drilled": chaos["breaker_trip"]["fired"] > 0,
+            "request_parity": bool(
+                surge["parity_ok"] and light["parity_ok"]
+            ),
+        }
+        record["checks"] = checks
+        record["value"] = int(sum(checks.values()))
+        record["checks_total"] = len(checks)
+    finally:
+        service.close()
+        for p in (swap_path, manifest_path(swap_path)):
+            try:
+                p.unlink()
+            except OSError:
+                pass
+
+    out_path = os.environ.get(
+        "ALBEDO_SERVING_OUT",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)), "SERVING_r02.json"),
+    )
+    try:
+        with open(out_path, "w") as f:
+            json.dump(record, f, indent=2)
+            f.write("\n")
+    except OSError as e:
+        record["record_write_error"] = repr(e)
+    failed = [name for name, ok in record.get("checks", {}).items() if not ok]
+    if failed:
+        fail("overload", f"overload contract checks failed: {failed}")
+    return record
+
+
 def datacheck_bench() -> dict:
     """The `datacheck` scenario: validation overhead on the ingest path.
 
@@ -2394,6 +2699,7 @@ def scoring_bench() -> dict:
 
 SCENARIOS = {
     "serving": serving_bench,
+    "overload": overload_bench,
     "datacheck": datacheck_bench,
     "foldin": foldin_bench,
     "capacity": capacity_bench,

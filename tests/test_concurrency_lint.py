@@ -109,7 +109,8 @@ def test_discovery_sees_every_known_spawn_site():
     assert threads == {
         "albedo-micro-batcher", "albedo-http", "albedo-reload-watch",
         "albedo-sighup-reload", "albedo-shard-prefetch",
-        "albedo-elastic-chunk",
+        "albedo-elastic-chunk", "albedo-loadgen-pacer",
+        "albedo-loadgen-worker",
     }
     # Every Thread spawn in the tree is daemonized (the PR 12 invariant).
     assert all(s.daemon for s in spawns if s.kind == "thread")

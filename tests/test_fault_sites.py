@@ -62,5 +62,6 @@ def test_known_sites_are_present():
         "als.shard.gather", "als.shard.stream", "als.shard.collective",
         "als.shard.prefetch", "retrieval.build", "retrieval.query",
         "score.shard", "score.spill", "score.publish",
+        "serving.admit", "loadgen.tick",
     ):
         assert site in code, f"expected fault site {site!r} not found in code"

@@ -1,0 +1,76 @@
+"""Open-loop load harness (PR 20): scheduled-time latency, parity
+accounting, SLO attainment, and the ``loadgen.tick`` chaos hole-punch."""
+
+import time
+
+import pytest
+
+from albedo_tpu.loadgen import OpenLoopLoadGen, percentiles
+from albedo_tpu.utils import faults
+
+
+def test_percentile_labels_and_empty():
+    assert percentiles([]) == {"p50": None, "p99": None, "p999": None}
+    out = percentiles([1.0, 2.0, 3.0, 4.0])
+    assert out["p50"] == pytest.approx(2.5)
+    assert set(out) == {"p50", "p99", "p999"}
+
+
+def test_report_shape_and_parity():
+    def fn(i):
+        return (429, {"brownout": {"level": 4, "tier": "shed"}}) if i % 3 == 0 \
+            else (200, {"items": []})
+
+    rep = OpenLoopLoadGen(fn, rate_hz=500, duration_s=0.1, budget_s=0.5,
+                          workers=4).run()
+    assert rep["mode"] == "open_loop"
+    assert rep["offered"] == 50
+    assert rep["completed"] == 50 and rep["parity_ok"]
+    assert rep["n_5xx"] == 0 and rep["transport_errors"] == 0
+    assert rep["status_counts"]["429"] == 17
+    assert rep["brownout_tiers_seen"] == ["shed"]
+    assert rep["slo"]["attainment"] <= 1.0
+    # SLO attainment is over OFFERED load: only the 200s can attain.
+    assert rep["slo"]["attainment"] <= 33 / 50
+
+
+def test_latency_is_measured_from_the_scheduled_tick():
+    """One slow worker behind a fast grid: a closed-loop client would
+    report ~service time for every request; the open-loop latency grows
+    with the backlog because it starts at the SCHEDULED tick."""
+    def fn(_i):
+        time.sleep(0.02)
+        return 200, {}
+
+    rep = OpenLoopLoadGen(fn, rate_hz=100, duration_s=0.1, budget_s=0.01,
+                          workers=1).run()
+    assert rep["completed"] == 10
+    # 10 ticks on a 10ms grid through one 20ms-per-request worker: the
+    # last request waited ~half the run in backlog.
+    assert rep["latency_s"]["max"] > 0.05
+    assert rep["slo"]["attainment"] < 1.0
+
+
+def test_5xx_and_transport_errors_are_distinct():
+    def fn(i):
+        if i % 2 == 0:
+            raise ConnectionError("boom")
+        return 503, {"error": "down"}
+
+    rep = OpenLoopLoadGen(fn, rate_hz=200, duration_s=0.05, workers=2).run()
+    assert rep["n_5xx"] == rep["status_counts"]["503"]
+    assert rep["transport_errors"] == rep["status_counts"]["0"]
+    assert rep["n_5xx"] + rep["transport_errors"] == rep["completed"]
+
+
+def test_tick_fault_punches_holes_and_parity_survives():
+    faults.arm("loadgen.tick", "error", at=3, times=4)
+    try:
+        rep = OpenLoopLoadGen(lambda i: (200, {}), rate_hz=500,
+                              duration_s=0.04, workers=2).run()
+    finally:
+        faults.disarm("loadgen.tick")
+    assert rep["offered"] == 20
+    assert rep["ticks_dropped"] == 4
+    assert rep["completed"] == 16
+    assert rep["parity_ok"]

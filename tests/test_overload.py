@@ -1,0 +1,378 @@
+"""Overload resilience (PR 20): AIMD admission, CoDel shed, the brownout
+ladder's hysteresis, and the over-HTTP forced-overload drill.
+
+The unit half drives the state machines with a fake clock — hysteresis,
+monotone degrade, recovery-window reversal, and per-tier shed accounting
+are all asserted deterministically. The HTTP half floods a real served
+engine with a hair-trigger overload config and asserts the PR-20 contract:
+no overload path ever returns a 5xx, every shed carries its tier tag, and
+the ladder fully recovers once the flood stops.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from albedo_tpu.datasets import synthetic_tables  # noqa: E402
+from albedo_tpu.models.als import ImplicitALS  # noqa: E402
+from albedo_tpu.serving import RecommendationService, serve  # noqa: E402
+from albedo_tpu.serving.batcher import QueueOverflow  # noqa: E402
+from albedo_tpu.serving.metrics import MetricsRegistry  # noqa: E402
+from albedo_tpu.serving.overload import (  # noqa: E402
+    LEVEL_FULL,
+    LEVEL_SHED,
+    TIERS,
+    AdaptiveLimit,
+    BrownoutLadder,
+    CoDelShedder,
+    OverloadConfig,
+    OverloadController,
+    tier_name,
+)
+from albedo_tpu.utils import faults  # noqa: E402
+
+
+class FakeClock:
+    def __init__(self, t: float = 100.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> float:
+        self.t += dt
+        return self.t
+
+
+# --------------------------------------------------------------- AIMD limit
+
+
+def test_aimd_grows_additively_and_cuts_multiplicatively():
+    cfg = OverloadConfig(slo_s=0.1, min_limit=2, max_limit=8)
+    lim = AdaptiveLimit(cfg, initial=4)
+    assert lim.limit == 4
+    assert lim.observe(0.05) == 5          # under SLO: +1
+    assert lim.observe(0.05) == 6
+    assert lim.observe(0.5) == 3           # breach: x0.5
+    assert lim.observe(0.5) == 2           # floor at min_limit
+    assert lim.observe(0.5) == 2
+    for _ in range(10):
+        lim.observe(0.01)
+    assert lim.limit == 8                  # ceiling at max_limit
+    assert lim.would_admit(7) and not lim.would_admit(8)
+
+
+def test_aimd_default_limit_is_the_static_bound():
+    cfg = OverloadConfig(max_limit=256)
+    lim = AdaptiveLimit(cfg)
+    assert lim.limit == 256                # unstressed == legacy bounded queue
+
+
+# ------------------------------------------------------------------- CoDel
+
+
+def test_codel_requires_a_full_interval_above_target():
+    clock = FakeClock()
+    codel = CoDelShedder(target_s=0.05, interval_s=1.0, clock=clock)
+    assert not codel.offer(0.01)           # under target: nothing
+    assert not codel.offer(0.2)            # first above: starts the clock
+    clock.advance(0.5)
+    assert not codel.offer(0.2)            # interval not yet elapsed
+    clock.advance(0.6)
+    assert codel.offer(0.2)                # sustained a full interval: shed
+    assert not codel.offer(0.2)            # next drop waits its cadence
+    clock.advance(1.0)
+    assert codel.offer(0.2)                # interval/sqrt(2) elapsed
+
+
+def test_codel_resets_when_sojourn_recovers():
+    clock = FakeClock()
+    codel = CoDelShedder(target_s=0.05, interval_s=1.0, clock=clock)
+    codel.offer(0.2)
+    clock.advance(1.1)
+    assert codel.offer(0.2)                # dropping
+    assert not codel.offer(0.01)           # back under target: full reset
+    assert not codel.offer(0.2)            # must re-earn the interval
+    clock.advance(0.5)
+    assert not codel.offer(0.2)
+
+
+# --------------------------------------------------------- brownout ladder
+
+
+def _ladder(clock, engage_after=3, dwell_s=0.5, recovery_window_s=2.0):
+    return BrownoutLadder(
+        engage_after=engage_after, dwell_s=dwell_s,
+        recovery_window_s=recovery_window_s, clock=clock,
+    )
+
+
+def test_ladder_needs_consecutive_pressure():
+    clock = FakeClock()
+    ladder = _ladder(clock)
+    clock.advance(1.0)                     # dwell since construction
+    assert ladder.observe(True) == 0
+    assert ladder.observe(True) == 0
+    assert ladder.observe(False) == 0      # calm resets the streak
+    assert ladder.observe(True) == 0
+    assert ladder.observe(True) == 0
+    assert ladder.observe(True) == 1       # third CONSECUTIVE signal engages
+
+
+def test_ladder_monotone_degrade_with_dwell_hysteresis():
+    clock = FakeClock()
+    ladder = _ladder(clock, engage_after=1, dwell_s=0.5)
+    clock.advance(1.0)
+    assert ladder.observe(True) == 1
+    assert ladder.observe(True) == 1       # dwell not elapsed: held at 1
+    clock.advance(0.5)
+    assert ladder.observe(True) == 2       # one tier per dwell, never a jump
+    clock.advance(0.5)
+    assert ladder.observe(True) == 3
+    clock.advance(0.5)
+    assert ladder.observe(True) == LEVEL_SHED
+    clock.advance(0.5)
+    assert ladder.observe(True) == LEVEL_SHED  # clamped at shed
+
+
+def test_ladder_recovers_one_tier_per_window():
+    clock = FakeClock()
+    ladder = _ladder(clock, engage_after=1, dwell_s=0.0, recovery_window_s=2.0)
+    clock.advance(1.0)
+    for _ in range(4):
+        ladder.observe(True)
+    assert ladder.level == LEVEL_SHED
+    ladder.observe(False)                  # calm starts the recovery window
+    clock.advance(1.9)
+    assert ladder.level == LEVEL_SHED      # window not yet held
+    clock.advance(0.2)
+    assert ladder.level == 3               # one full window: one step down
+    clock.advance(2.0)
+    assert ladder.level == 2
+    clock.advance(50.0)
+    assert ladder.level == LEVEL_FULL      # passive decay walks all the way
+
+
+def test_ladder_pressure_restarts_the_recovery_window():
+    clock = FakeClock()
+    ladder = _ladder(clock, engage_after=3, dwell_s=0.0, recovery_window_s=2.0)
+    clock.advance(1.0)
+    for _ in range(3):
+        ladder.observe(True)
+    assert ladder.level == 1
+    ladder.observe(False)
+    clock.advance(1.5)
+    ladder.observe(True)                   # a blip mid-recovery
+    clock.advance(1.9)
+    ladder.observe(False)
+    assert ladder.level == 1               # window restarted by the blip
+    clock.advance(2.1)
+    assert ladder.level == LEVEL_FULL
+
+
+# ------------------------------------------------------------- controller
+
+
+def test_controller_counts_sheds_per_tier():
+    clock = FakeClock()
+    metrics = MetricsRegistry()
+    cfg = OverloadConfig(
+        slo_s=0.1, min_limit=1, max_limit=1,
+        engage_after=1, dwell_s=0.0, recovery_window_s=60.0,
+    )
+    ctl = OverloadController(cfg, metrics=metrics, clock=clock)
+    clock.advance(1.0)
+    # A limit rejection feeds the ladder as pressure FIRST, so the shed is
+    # counted under the tier that rejection itself put in force.
+    assert not ctl.admit(outstanding=1)
+    assert ctl.brownout_level == 1
+    assert metrics.overload_shed.value(tier="skip_rerank") == 1
+    # Climb to shed and verify the shed-tier accounting.
+    for _ in range(3):
+        clock.advance(0.1)
+        ctl.ladder.observe(True)
+    assert ctl.brownout_level == LEVEL_SHED
+    assert not ctl.admit(outstanding=0)
+    assert metrics.overload_shed.value(tier="shed") == 1
+    assert metrics.brownout_level.value() == LEVEL_SHED
+    assert metrics.admission_limit.value() == 1
+
+
+def test_shed_tier_rejections_do_not_wedge_recovery():
+    clock = FakeClock()
+    cfg = OverloadConfig(
+        min_limit=1, max_limit=8,
+        engage_after=1, dwell_s=0.0, recovery_window_s=1.0,
+    )
+    ctl = OverloadController(cfg, clock=clock)
+    clock.advance(1.0)
+    for _ in range(4):
+        clock.advance(0.1)
+        ctl.ladder.observe(True)
+    assert ctl.brownout_level == LEVEL_SHED
+    ctl.ladder.observe(False)
+    # A trickle of rejected requests during recovery must NOT reset the
+    # window — only LIMIT rejections are pressure, shed-tier ones are not.
+    for _ in range(10):
+        clock.advance(0.3)
+        ctl.admit(outstanding=0)
+    assert ctl.brownout_level < LEVEL_SHED
+    clock.advance(10.0)
+    assert ctl.brownout_level == LEVEL_FULL
+    assert ctl.admit(outstanding=0)
+
+
+def test_admit_fault_site_forces_the_shed_path():
+    ctl = OverloadController(OverloadConfig())
+    faults.arm("serving.admit", "error", at=1)
+    try:
+        assert not ctl.admit(outstanding=0)   # armed fault = shed, no raise
+        assert ctl.admit(outstanding=0)       # exhausted: clean again
+    finally:
+        faults.disarm("serving.admit")
+
+
+def test_retry_after_prices_limit_and_brownout():
+    clock = FakeClock()
+    cfg = OverloadConfig(min_limit=1, max_limit=10,
+                         engage_after=1, dwell_s=0.0, recovery_window_s=60.0)
+    ctl = OverloadController(cfg, clock=clock)
+    clock.advance(1.0)
+    base = ctl.price_retry_after(1.0, outstanding=0)
+    assert base == pytest.approx(1.0)      # level 0, empty queue: unchanged
+    ctl.ladder.observe(True)
+    ctl.ladder.observe(True)
+    level = ctl.brownout_level
+    assert level >= 1
+    priced = ctl.price_retry_after(1.0, outstanding=0)
+    assert priced == pytest.approx(1.0 + level)   # brownout multiplies
+    congested = ctl.price_retry_after(1.0, outstanding=29)
+    assert congested == pytest.approx((1.0 + level) * 3.0)  # (29+1)/10
+
+
+def test_tier_names_cover_the_ladder():
+    assert TIERS == ("full", "skip_rerank", "bank_only",
+                     "cache_popularity", "shed")
+    assert tier_name(-3) == "full" and tier_name(99) == "shed"
+
+
+# --------------------------------------------- the over-HTTP overload drill
+
+
+@pytest.fixture(scope="module")
+def overload_world():
+    tables = synthetic_tables(n_users=100, n_items=60, mean_stars=6, seed=13)
+    matrix = tables.star_matrix()
+    model = ImplicitALS(rank=8, max_iter=2, seed=0).fit(matrix)
+    return tables, matrix, model
+
+
+def _get(handle, path):
+    host, port = handle.server_address[:2]
+    try:
+        with urllib.request.urlopen(f"http://{host}:{port}{path}", timeout=30) as r:
+            return r.status, json.loads(r.read() or b"{}"), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}"), dict(e.headers)
+
+
+def test_http_forced_overload_drill(overload_world):
+    """Flood a served engine configured with a hair-trigger SLO: every
+    response is a 200 or a tier-tagged 429 (never a 5xx), the ladder
+    engages, and it fully recovers once the flood stops."""
+    tables, matrix, model = overload_world
+    svc = RecommendationService(
+        model, matrix, repo_info=tables.repo_info,
+        batching=True, batch_window_ms=5.0,
+        overload_config=OverloadConfig(
+            slo_s=1e-4,                    # every real batch breaches
+            min_limit=1, max_limit=4,
+            engage_after=2, dwell_s=0.05, recovery_window_s=0.3,
+            codel_target_s=0.01, codel_interval_s=0.05,
+        ),
+    )
+    user_ids = matrix.user_ids
+    results: list[tuple[int, dict, dict]] = []
+    res_lock = threading.Lock()
+
+    def flood(ci: int) -> None:
+        rng = np.random.default_rng(ci)
+        local = []
+        for _ in range(8):
+            uid = int(user_ids[int(rng.integers(0, len(user_ids)))])
+            local.append(_get(handle, f"/recommend/{uid}?k=5"))
+        with res_lock:
+            results.extend(local)
+
+    with serve(svc, port=0) as handle:
+        threads = [
+            threading.Thread(target=flood, args=(ci,), daemon=True)
+            for ci in range(16)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+
+        statuses = {s for s, _, _ in results}
+        assert statuses <= {200, 429}, f"unexpected statuses: {statuses}"
+        n_429 = sum(1 for s, _, _ in results if s == 429)
+        tagged = [b for s, b, _ in results if b.get("brownout")]
+        assert tagged, "the flood never engaged the brownout ladder"
+        # Every degraded/shed response carries a coherent tier tag (a
+        # limit shed BEFORE the ladder engages is legitimately level 0).
+        for body in tagged:
+            assert body["brownout"]["tier"] in TIERS
+            assert 0 <= body["brownout"]["level"] <= LEVEL_SHED
+            assert body["brownout"]["tier"] == TIERS[body["brownout"]["level"]]
+        assert any(b["brownout"]["level"] >= 1 for b in tagged), (
+            "the ladder never escalated past full during the flood"
+        )
+        # Every 429 is priced: Retry-After present and positive.
+        for s, body, headers in results:
+            if s == 429:
+                assert float(headers.get("Retry-After", 0)) > 0
+        # Every 429 the clients saw is accounted in the per-tier counter.
+        assert svc.metrics.overload_shed.total() == n_429
+
+        # Recovery: no traffic -> idle ticks + passive decay walk the
+        # ladder back to full work, and a fresh request is a clean 200.
+        deadline = time.monotonic() + 30
+        while svc.overload.brownout_level > 0 and time.monotonic() < deadline:
+            time.sleep(0.1)
+        assert svc.overload.brownout_level == LEVEL_FULL
+        status, body, _ = _get(
+            handle, f"/recommend/{int(user_ids[0])}?k=5"
+        )
+        assert status == 200 and not body.get("brownout")
+
+
+def test_queue_overflow_carries_tier_and_level(overload_world):
+    """The QueueOverflow raised at the shed tier carries the tag the HTTP
+    layer serializes — drilled directly, without load."""
+    tables, matrix, model = overload_world
+    svc = RecommendationService(
+        model, matrix, batching=True,
+        overload_config=OverloadConfig(
+            engage_after=1, dwell_s=0.0, recovery_window_s=60.0,
+        ),
+    )
+    try:
+        for _ in range(4):
+            svc.overload.ladder.observe(True)
+            time.sleep(0.01)
+        assert svc.overload.brownout_level == LEVEL_SHED
+        with pytest.raises(QueueOverflow) as exc:
+            svc.handle_recommend(int(matrix.user_ids[0]), k=5)
+        assert exc.value.tier == "shed"
+        assert exc.value.level == LEVEL_SHED
+        assert exc.value.retry_after_s and exc.value.retry_after_s > 0
+    finally:
+        svc.close()
